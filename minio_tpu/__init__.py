@@ -1,0 +1,26 @@
+"""minio_tpu — a TPU-native, S3-compatible, erasure-coded object store.
+
+A from-scratch rebuild of the capabilities of chiefsh/minio (pure Go,
+reference at /root/reference) designed TPU-first:
+
+- The data plane (Reed-Solomon GF(2^8) encode/decode, bitrot hashing) runs as
+  batched JAX/Pallas kernels on TPU: GF(2^8) linear algebra is lowered to
+  GF(2) bit-plane matmuls that map directly onto the MXU, instead of the
+  reference's table-lookup SIMD assembly (klauspost/reedsolomon, ref
+  cmd/erasure-coding.go).
+- The host runtime (S3 front end, topology, disk I/O, quorum orchestration,
+  locks, healing) is Python + C++ where hot.
+- Multi-chip scaling uses jax.sharding.Mesh + shard_map over batch/shard axes;
+  multi-host control plane is REST like the reference (cmd/routers.go:26-37).
+
+Layout:
+  ops/       TPU + CPU kernels (GF(2^8), Reed-Solomon, HighwayHash, batching)
+  models/    declarative data-plane pipelines (the "flagship model" = EC pipeline)
+  parallel/  mesh/sharding + host-side parallel quorum machinery
+  erasure/   erasure codec orchestration, metadata quorum, healing
+  storage/   per-disk storage (xl-storage analog), on-disk formats
+  s3/        S3 API surface: SigV4, routers, handlers, errors
+  utils/     small shared helpers
+"""
+
+__version__ = "0.1.0"

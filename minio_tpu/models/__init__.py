@@ -1,0 +1,5 @@
+"""Declarative data-plane pipelines (the framework's "models").
+
+A pipeline here is an erasure-coding configuration plus the jittable compute
+graph that implements its hot path (encode / reconstruct / hash) on TPU.
+"""

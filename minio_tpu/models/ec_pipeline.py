@@ -1,0 +1,120 @@
+"""The flagship data-plane pipeline: erasure-code step as a jittable graph.
+
+This is the framework's "model": a declarative EC configuration (k data +
+m parity, shard size) compiled into the TPU hot path that a PutObject /
+GetObject / heal dispatches to (ref call stacks: cmd/erasure-object.go:582
+encode, :240 decode, cmd/erasure-healing.go:224 heal).
+
+forward step  = encode:      (B, k, S) data shards   -> (B, k+m, S)
+reconstruct   = decode:      (B, k, S) survivors     -> (B, r, S) rebuilt
+verify        = parity check reduced to one scalar per batch (psum across
+                the mesh in the sharded path).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import cached_property
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import rs_tpu
+from ..ops.rs_matrix import encode_matrix
+from ..utils import ceil_frac
+
+# Reference stripe block: 10 MiB (ref cmd/object-api-common.go:32).
+DEFAULT_BLOCK_SIZE = 10 * 1024 * 1024
+
+
+@dataclass(frozen=True)
+class ECConfig:
+    data_shards: int
+    parity_shards: int
+    block_size: int = DEFAULT_BLOCK_SIZE
+
+    @property
+    def total_shards(self) -> int:
+        return self.data_shards + self.parity_shards
+
+    @property
+    def shard_size(self) -> int:
+        """Per-shard bytes of one full stripe block (ref ShardSize,
+        cmd/erasure-coding.go:115)."""
+        return ceil_frac(self.block_size, self.data_shards)
+
+
+class ECPipeline:
+    """Compiled erasure pipeline for one EC geometry."""
+
+    def __init__(self, config: ECConfig):
+        self.config = config
+
+    @cached_property
+    def parity_bitplane(self) -> jnp.ndarray:
+        return jnp.asarray(
+            rs_tpu.parity_bitplane(self.config.data_shards,
+                                   self.config.parity_shards))
+
+    @cached_property
+    def encode_fn(self):
+        """Jittable (big_m, (B, k, S) uint8) -> (B, k+m, S) uint8."""
+        return rs_tpu.encode_blocks
+
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        return np.asarray(self.encode_fn(self.parity_bitplane,
+                                         jnp.asarray(data)))
+
+    def reconstruct(self, survivors: np.ndarray,
+                    available: tuple[int, ...],
+                    missing: tuple[int, ...]) -> np.ndarray:
+        return rs_tpu.reconstruct_batch(
+            survivors, self.config.data_shards, self.config.parity_shards,
+            available, missing)
+
+    def example_args(self, batch: int = 4, shard_size: int = 4096,
+                     seed: int = 0) -> tuple[jnp.ndarray, jnp.ndarray]:
+        rng = np.random.default_rng(seed)
+        data = rng.integers(
+            0, 256, (batch, self.config.data_shards, shard_size),
+        ).astype(np.uint8)
+        return self.parity_bitplane, jnp.asarray(data)
+
+
+def full_step(big_enc: jnp.ndarray, big_dec: jnp.ndarray,
+              data: jnp.ndarray, survivor_idx: jnp.ndarray) -> dict:
+    """One full data-plane step, for multi-chip compilation checks:
+    encode -> simulated shard loss -> reconstruct -> global verify.
+
+    survivor_idx: (k,) int32 indices of surviving shards (static-shaped
+    gather, dynamic values). Returns rebuilt shards and a global integrity
+    scalar (sum over everything — reduces across the mesh).
+    """
+    shards = rs_tpu.encode_blocks(big_enc, data)
+    survivors = jnp.take(shards, survivor_idx, axis=-2)
+    rebuilt = rs_tpu.gf_apply(big_dec, survivors)
+    mismatch = jnp.sum(
+        (rebuilt.astype(jnp.int32) - data.astype(jnp.int32)) != 0)
+    return {"shards": shards, "rebuilt": rebuilt, "mismatch": mismatch}
+
+
+def make_full_step_inputs(config: ECConfig, batch: int, shard_size: int,
+                          missing: tuple[int, ...], seed: int = 0):
+    """Host-side prep for full_step: matrices + data + survivor indices.
+
+    `missing` are data-shard indices knocked out; the decode matrix rebuilds
+    exactly those from the first-k survivors (klauspost ReconstructData
+    order — see rs_matrix.decode_matrix).
+    """
+    k, m = config.data_shards, config.parity_shards
+    available = tuple(i for i in range(k + m) if i not in missing)
+    # full_step compares rebuilt vs the full data input, so the decode
+    # matrix covers every data shard (not just `missing`).
+    dec_all, used = rs_tpu.decode_bitplane(k, m, available,
+                                           tuple(range(k)))
+    rng = np.random.default_rng(seed)
+    data = rng.integers(0, 256, (batch, k, shard_size)).astype(np.uint8)
+    big_enc = rs_tpu.parity_bitplane(k, m)
+    return (jnp.asarray(big_enc), jnp.asarray(dec_all), jnp.asarray(data),
+            jnp.asarray(np.array(used, dtype=np.int32)))

@@ -1,0 +1,3 @@
+"""TPU + CPU data-plane kernels: GF(2^8), Reed-Solomon, HighwayHash."""
+
+from . import gf256, rs_cpu, rs_matrix  # noqa: F401

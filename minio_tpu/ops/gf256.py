@@ -1,0 +1,172 @@
+"""GF(2^8) arithmetic (host side, numpy).
+
+Field: GF(2^8) with the reducing polynomial x^8 + x^4 + x^3 + x^2 + 1
+(0x11D), generator 2 — the same field the reference's codec dependency
+(klauspost/reedsolomon galois tables; see /root/reference/go.mod:41 and
+cmd/erasure-coding.go:23) uses, so encoded shards are byte-identical.
+
+Everything here is table-driven numpy for host-side matrix construction and
+the golden CPU reference codec. The TPU kernels (rs_tpu.py) do not use these
+tables at runtime — they lower GF(2^8) linear maps to GF(2) bit-plane
+matmuls — but their matrices are built from this module.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+FIELD_POLY = 0x11D  # x^8 + x^4 + x^3 + x^2 + 1
+
+
+def _build_tables() -> tuple[np.ndarray, np.ndarray]:
+    exp = np.zeros(255, dtype=np.uint8)
+    log = np.zeros(256, dtype=np.int32)
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x <<= 1
+        if x & 0x100:
+            x ^= FIELD_POLY
+    return exp, log
+
+
+EXP_TABLE, LOG_TABLE = _build_tables()
+
+# Full 256x256 multiplication table: MUL_TABLE[a, b] = a*b in GF(2^8).
+# 64 KiB; the workhorse for the vectorized CPU reference encoder.
+_a = np.arange(256)
+_la = LOG_TABLE[_a][:, None]
+_lb = LOG_TABLE[_a][None, :]
+MUL_TABLE = EXP_TABLE[(_la + _lb) % 255].copy()
+MUL_TABLE[0, :] = 0
+MUL_TABLE[:, 0] = 0
+del _a, _la, _lb
+
+
+def gf_mul(a: int, b: int) -> int:
+    """Multiply two field elements."""
+    return int(MUL_TABLE[a, b])
+
+
+def gf_div(a: int, b: int) -> int:
+    """Divide a by b. b must be nonzero."""
+    if b == 0:
+        raise ZeroDivisionError("GF(2^8) division by zero")
+    if a == 0:
+        return 0
+    return int(EXP_TABLE[(LOG_TABLE[a] - LOG_TABLE[b]) % 255])
+
+
+def gf_inv(a: int) -> int:
+    """Multiplicative inverse."""
+    if a == 0:
+        raise ZeroDivisionError("GF(2^8) inverse of zero")
+    return int(EXP_TABLE[(255 - LOG_TABLE[a]) % 255])
+
+
+def gf_exp(a: int, n: int) -> int:
+    """a raised to the n-th power (klauspost galExp semantics).
+
+    galExp(a, 0) == 1 for any a, galExp(0, n) == 0 for n > 0 — this exact
+    convention determines the Vandermonde matrix and therefore shard bytes.
+    """
+    if n == 0:
+        return 1
+    if a == 0:
+        return 0
+    return int(EXP_TABLE[(LOG_TABLE[a] * n) % 255])
+
+
+def gf_matmul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Matrix multiply over GF(2^8). a: (r, n) uint8, b: (n, c) uint8."""
+    a = np.asarray(a, dtype=np.uint8)
+    b = np.asarray(b, dtype=np.uint8)
+    # products[i, k, j] = a[i, k] * b[k, j]; XOR-reduce over k.
+    prods = MUL_TABLE[a[:, :, None], b[None, :, :]]
+    return np.bitwise_xor.reduce(prods, axis=1)
+
+
+def gf_mat_vec_apply(mat: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """Apply (r, k) GF matrix to (k, n) byte rows -> (r, n).
+
+    This is the CPU reference hot loop: out[i] = XOR_j mat[i,j] * data[j,:],
+    each scalar-vector product a table gather.
+    """
+    mat = np.asarray(mat, dtype=np.uint8)
+    data = np.asarray(data, dtype=np.uint8)
+    out = np.zeros((mat.shape[0], data.shape[1]), dtype=np.uint8)
+    for i in range(mat.shape[0]):
+        acc = out[i]
+        for j in range(mat.shape[1]):
+            c = mat[i, j]
+            if c == 0:
+                continue
+            acc ^= MUL_TABLE[c][data[j]]
+        out[i] = acc
+    return out
+
+
+def gf_mat_invert(m: np.ndarray) -> np.ndarray:
+    """Invert a square matrix over GF(2^8) via Gauss-Jordan.
+
+    Raises ValueError if singular. The inverse is unique, so any correct
+    elimination order yields the same bytes as the reference's.
+    """
+    m = np.array(m, dtype=np.uint8)
+    n = m.shape[0]
+    if m.shape != (n, n):
+        raise ValueError("matrix must be square")
+    aug = np.concatenate([m, np.eye(n, dtype=np.uint8)], axis=1)
+    for col in range(n):
+        # Find pivot.
+        pivot = -1
+        for r in range(col, n):
+            if aug[r, col] != 0:
+                pivot = r
+                break
+        if pivot < 0:
+            raise ValueError("singular matrix over GF(2^8)")
+        if pivot != col:
+            aug[[col, pivot]] = aug[[pivot, col]]
+        # Scale pivot row to 1.
+        inv = gf_inv(int(aug[col, col]))
+        aug[col] = MUL_TABLE[inv][aug[col]]
+        # Eliminate all other rows.
+        for r in range(n):
+            if r != col and aug[r, col] != 0:
+                aug[r] ^= MUL_TABLE[int(aug[r, col])][aug[col]]
+    return aug[:, n:].copy()
+
+
+# --- GF(2) bit-plane lowering -------------------------------------------------
+#
+# Multiplication by a constant c in GF(2^8) is linear over GF(2): there is an
+# 8x8 0/1 matrix M_c with y_bits = M_c @ x_bits (mod 2). Column a of M_c is
+# the bit pattern of c * 2^a. A whole (r, k) GF(2^8) matrix therefore lowers
+# to an (8r, 8k) GF(2) matrix, and applying it to byte streams becomes a
+# plain integer matmul followed by mod-2 — which is exactly what the TPU MXU
+# is good at. This is the core idea of the TPU-native codec.
+
+
+def gf_matrix_to_bitplane(mat: np.ndarray) -> np.ndarray:
+    """Lower an (r, k) GF(2^8) matrix to its (8r, 8k) GF(2) bit matrix.
+
+    Layout: output bit row i*8+b is bit b (LSB-first) of output byte i;
+    input bit column j*8+a is bit a of input byte j.
+    """
+    mat = np.asarray(mat, dtype=np.uint8)
+    r, k = mat.shape
+    out = np.zeros((8 * r, 8 * k), dtype=np.uint8)
+    shifts = np.arange(8)
+    for i in range(r):
+        for j in range(k):
+            c = int(mat[i, j])
+            if c == 0:
+                continue
+            # prods[a] = c * 2^a in GF(2^8)
+            prods = MUL_TABLE[c][np.left_shift(1, shifts)]
+            # block[b, a] = bit b of prods[a]
+            block = (prods[None, :] >> shifts[:, None]) & 1
+            out[i * 8:(i + 1) * 8, j * 8:(j + 1) * 8] = block
+    return out

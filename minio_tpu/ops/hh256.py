@@ -1,0 +1,188 @@
+"""HighwayHash-256 — the default bitrot checksum algorithm.
+
+The reference hashes every shard sub-block with HighwayHash-256 keyed by a
+magic 256-bit key (ref cmd/bitrot.go:31,35-46; minio/highwayhash go.mod:48).
+Checksums must be byte-identical, so this module implements the HighwayHash
+algorithm (SipHash-style 4x64-bit lane mixer with 32x32->64 multiplies,
+zipper-merge byte shuffles, and mod-(2^61-like) finalization) from the
+published specification.
+
+Self-verification: the reference documents its magic key as "HH-256 hash of
+the first 100 decimals of pi as utf-8 string with a zero key" — that is a
+golden test vector, checked in tests/test_hh256.py and asserted at import
+via MAGIC_KEY_SELF_TEST.
+
+This file is the *reference* implementation (python ints — slow). Bulk
+hashing uses hh256_numpy (vectorized across independent chunks) and the
+TPU path in later kernels.
+"""
+
+from __future__ import annotations
+
+import struct
+
+M64 = (1 << 64) - 1
+M32 = 0xFFFFFFFF
+
+_INIT0 = (0xDBE6D5D5FE4CCE2F, 0xA4093822299F31D0,
+          0x13198A2E03707344, 0x243F6A8885A308D3)
+_INIT1 = (0x3BD39E10CB0EF593, 0xC0ACF169B5F18A8C,
+          0xBE5466CF34E90C6C, 0x452821E638D01377)
+
+# ref cmd/bitrot.go:31 — HH-256 of the first 100 decimals of pi, zero key.
+MAGIC_KEY = bytes.fromhex(
+    "4be734fa8e238acd263e83e6bb968552040f935da39f441497e09d1322de36a0")
+
+PI_100_DECIMALS = (
+    "1415926535897932384626433832795028841971"
+    "6939937510582097494459230781640628620899"
+    "86280348253421170679")
+
+
+def _rot32_halves(x: int, count: int) -> int:
+    """Rotate each 32-bit half of x left by count."""
+    lo = x & M32
+    hi = (x >> 32) & M32
+    lo = ((lo << count) | (lo >> ((32 - count) & 31))) & M32 if count else lo
+    hi = ((hi << count) | (hi >> ((32 - count) & 31))) & M32 if count else hi
+    return (hi << 32) | lo
+
+
+def _swap32(x: int) -> int:
+    return ((x & M32) << 32) | (x >> 32)
+
+
+class HighwayHash256:
+    """Streaming HighwayHash-256 (hashlib-like: update()/digest())."""
+
+    digest_size = 32
+    block_size = 32
+
+    def __init__(self, key: bytes = MAGIC_KEY):
+        if len(key) != 32:
+            raise ValueError("HighwayHash key must be 32 bytes")
+        self._key = struct.unpack("<4Q", key)
+        self._buf = b""
+        self._reset()
+
+    def _reset(self) -> None:
+        key = self._key
+        self.mul0 = list(_INIT0)
+        self.mul1 = list(_INIT1)
+        self.v0 = [_INIT0[i] ^ key[i] for i in range(4)]
+        self.v1 = [_INIT1[i] ^ _swap32(key[i]) for i in range(4)]
+        self._buf = b""
+
+    def reset(self) -> None:
+        self._reset()
+
+    def _zipper_merge_and_add(self, v1: int, v0: int, add: list[int],
+                              i1: int, i0: int) -> None:
+        add[i0] = (add[i0] + (
+            (((v0 & 0xFF000000) | (v1 & 0xFF00000000)) >> 24) |
+            (((v0 & 0xFF0000000000) | (v1 & 0xFF000000000000)) >> 16) |
+            (v0 & 0xFF0000) | ((v0 & 0xFF00) << 32) |
+            ((v1 & 0xFF00000000000000) >> 8) | ((v0 << 56) & M64)
+        )) & M64
+        add[i1] = (add[i1] + (
+            (((v1 & 0xFF000000) | (v0 & 0xFF00000000)) >> 24) |
+            (v1 & 0xFF0000) | ((v1 & 0xFF0000000000) >> 16) |
+            ((v1 & 0xFF00) << 24) | ((v0 & 0xFF000000000000) >> 8) |
+            ((v1 & 0xFF) << 48) | (v0 & 0xFF00000000000000)
+        )) & M64
+
+    def _update_lanes(self, lanes: tuple[int, int, int, int]) -> None:
+        v0, v1, mul0, mul1 = self.v0, self.v1, self.mul0, self.mul1
+        for i in range(4):
+            v1[i] = (v1[i] + mul0[i] + lanes[i]) & M64
+            mul0[i] ^= ((v1[i] & M32) * (v0[i] >> 32)) & M64
+            v0[i] = (v0[i] + mul1[i]) & M64
+            mul1[i] ^= ((v0[i] & M32) * (v1[i] >> 32)) & M64
+        self._zipper_merge_and_add(v1[1], v1[0], v0, 1, 0)
+        self._zipper_merge_and_add(v1[3], v1[2], v0, 3, 2)
+        self._zipper_merge_and_add(v0[1], v0[0], v1, 1, 0)
+        self._zipper_merge_and_add(v0[3], v0[2], v1, 3, 2)
+
+    def _update_packet(self, packet: bytes) -> None:
+        self._update_lanes(struct.unpack("<4Q", packet))
+
+    def update(self, data: bytes) -> "HighwayHash256":
+        buf = self._buf + bytes(data)
+        n = len(buf) - (len(buf) % 32)
+        for off in range(0, n, 32):
+            self._update_packet(buf[off:off + 32])
+        self._buf = buf[n:]
+        return self
+
+    def _update_remainder(self, bytes_: bytes) -> None:
+        size_mod32 = len(bytes_)
+        size_mod4 = size_mod32 & 3
+        remainder_off = size_mod32 & ~3
+        packet = bytearray(32)
+        for i in range(4):
+            self.v0[i] = (self.v0[i] +
+                          ((size_mod32 << 32) + size_mod32)) & M64
+        for i in range(4):
+            self.v1[i] = _rot32_halves(self.v1[i], size_mod32 & 31)
+        packet[:remainder_off] = bytes_[:remainder_off]
+        if size_mod32 & 16:
+            for i in range(4):
+                packet[28 + i] = bytes_[remainder_off + i + size_mod4 - 4]
+        elif size_mod4:
+            packet[16 + 0] = bytes_[remainder_off]
+            packet[16 + 1] = bytes_[remainder_off + (size_mod4 >> 1)]
+            packet[16 + 2] = bytes_[remainder_off + size_mod4 - 1]
+        self._update_packet(bytes(packet))
+
+    def _permute_and_update(self) -> None:
+        v0 = self.v0
+        self._update_lanes((_swap32(v0[2]), _swap32(v0[3]),
+                            _swap32(v0[0]), _swap32(v0[1])))
+
+    @staticmethod
+    def _modular_reduction(a3u: int, a2: int, a1: int, a0: int,
+                           ) -> tuple[int, int]:
+        """Returns (m1, m0)."""
+        a3 = a3u & 0x3FFFFFFFFFFFFFFF
+        m1 = a1 ^ (((a3 << 1) | (a2 >> 63)) & M64) ^ (((a3 << 2) |
+                                                       (a2 >> 62)) & M64)
+        m0 = a0 ^ ((a2 << 1) & M64) ^ ((a2 << 2) & M64)
+        return m1, m0
+
+    def digest(self) -> bytes:
+        # Work on a copy so digest() is idempotent (hash.Hash Sum contract).
+        st = HighwayHash256.__new__(HighwayHash256)
+        st.v0, st.v1 = list(self.v0), list(self.v1)
+        st.mul0, st.mul1 = list(self.mul0), list(self.mul1)
+        st._buf = b""
+        if self._buf:
+            st._update_remainder(self._buf)
+        for _ in range(10):
+            st._permute_and_update()
+        h1, h0 = self._modular_reduction(
+            (st.v1[1] + st.mul1[1]) & M64, (st.v1[0] + st.mul1[0]) & M64,
+            (st.v0[1] + st.mul0[1]) & M64, (st.v0[0] + st.mul0[0]) & M64)
+        h3, h2 = self._modular_reduction(
+            (st.v1[3] + st.mul1[3]) & M64, (st.v1[2] + st.mul1[2]) & M64,
+            (st.v0[3] + st.mul0[3]) & M64, (st.v0[2] + st.mul0[2]) & M64)
+        return struct.pack("<4Q", h0, h1, h2, h3)
+
+    def hexdigest(self) -> str:
+        return self.digest().hex()
+
+
+def hh256(data: bytes, key: bytes = MAGIC_KEY) -> bytes:
+    """One-shot HighwayHash-256."""
+    h = HighwayHash256(key)
+    h.update(data)
+    return h.digest()
+
+
+def _self_test() -> bool:
+    return hh256(PI_100_DECIMALS.encode(), b"\x00" * 32) == MAGIC_KEY
+
+
+MAGIC_KEY_SELF_TEST = _self_test()
+assert MAGIC_KEY_SELF_TEST, (
+    "HighwayHash-256 implementation no longer reproduces the reference "
+    "magic bitrot key (cmd/bitrot.go:31) — bitrot checksums would be wrong")

@@ -1,0 +1,106 @@
+"""CPU reference Reed-Solomon codec (numpy, table-driven).
+
+Semantics mirror the reference's codec seam (Erasure.EncodeData /
+DecodeDataBlocks, ref cmd/erasure-coding.go:70,89 and the underlying
+klauspost Encoder contract):
+
+- split(data): k shards of ceil(len/k) bytes, zero-padded (ref Split,
+  dependency of cmd/erasure-coding.go:74).
+- encode: parity rows of the systematic matrix applied to the data shards.
+- reconstruct_data: rebuild missing DATA shards from any k survivors.
+- reconstruct: rebuild all missing shards (data + parity).
+
+This is the golden model for the TPU kernels and the byte-identity oracle
+for tests. It is deliberately simple; the fast CPU path is rs_native (C++)
+and the fast device path is rs_tpu.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils import ceil_frac
+from .gf256 import gf_mat_vec_apply
+from .rs_matrix import decode_matrix, encode_matrix, parity_matrix
+
+
+def shard_len(data_len: int, k: int) -> int:
+    return ceil_frac(data_len, k)
+
+
+def split(data: bytes | np.ndarray, k: int, m: int) -> np.ndarray:
+    """Split a byte buffer into a (k+m, shard_len) array.
+
+    Data shards hold the (zero-padded) input; parity rows are zero until
+    encode() fills them. Empty input is rejected like the reference
+    (ErrShortData).
+    """
+    buf = np.frombuffer(bytes(data), dtype=np.uint8) if not isinstance(
+        data, np.ndarray) else data.astype(np.uint8, copy=False).ravel()
+    if buf.size == 0:
+        raise ValueError("cannot split empty data")
+    per = shard_len(buf.size, k)
+    shards = np.zeros((k + m, per), dtype=np.uint8)
+    padded = np.zeros(k * per, dtype=np.uint8)
+    padded[:buf.size] = buf
+    shards[:k] = padded.reshape(k, per)
+    return shards
+
+
+def encode(shards: np.ndarray, k: int, m: int) -> np.ndarray:
+    """Fill parity rows in-place from data rows; returns shards."""
+    pm = parity_matrix(k, m)
+    shards[k:] = gf_mat_vec_apply(pm, shards[:k])
+    return shards
+
+
+def encode_data(data: bytes, k: int, m: int) -> np.ndarray:
+    """split + encode, the EncodeData equivalent."""
+    return encode(split(data, k, m), k, m)
+
+
+def join(shards: np.ndarray, k: int, data_len: int) -> bytes:
+    """Concatenate data shards and trim padding to the original length."""
+    return shards[:k].tobytes()[:data_len]
+
+
+def reconstruct_data(shards: list[np.ndarray | None], k: int, m: int,
+                     ) -> list[np.ndarray]:
+    """Rebuild missing data shards. `shards` has k+m entries, None = missing.
+
+    Returns the full list with data entries (0..k-1) all filled; parity
+    entries are left as-is (possibly None) — matching ReconstructData.
+    """
+    available = [i for i, s in enumerate(shards) if s is not None]
+    missing_data = [i for i in range(k) if shards[i] is None]
+    if not missing_data:
+        return list(shards)
+    dec, used = decode_matrix(k, m, available)
+    src = np.stack([shards[i] for i in used])
+    rows = dec[missing_data, :]
+    rebuilt = gf_mat_vec_apply(rows, src)
+    out = list(shards)
+    for idx, r in zip(missing_data, rebuilt):
+        out[idx] = r
+    return out
+
+
+def reconstruct(shards: list[np.ndarray | None], k: int, m: int,
+                ) -> list[np.ndarray]:
+    """Rebuild ALL missing shards (data then parity re-encode)."""
+    out = reconstruct_data(shards, k, m)
+    missing_parity = [i for i in range(k, k + m) if out[i] is None]
+    if missing_parity:
+        pm = encode_matrix(k, m)[missing_parity, :]
+        data = np.stack(out[:k])
+        rebuilt = gf_mat_vec_apply(pm, data)
+        for idx, r in zip(missing_parity, rebuilt):
+            out[idx] = r
+    return out
+
+
+def verify(shards: np.ndarray, k: int, m: int) -> bool:
+    """Check parity consistency (Encoder.Verify equivalent)."""
+    pm = parity_matrix(k, m)
+    expect = gf_mat_vec_apply(pm, shards[:k])
+    return bool(np.array_equal(expect, shards[k:]))

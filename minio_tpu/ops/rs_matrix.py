@@ -1,0 +1,78 @@
+"""Reed-Solomon encoding matrix construction, byte-compatible with the
+reference's codec dependency.
+
+The reference creates its encoder as `reedsolomon.New(k, m)` (ref
+cmd/erasure-coding.go:56) which uses the default systematic-Vandermonde
+construction:
+
+    vm[r, c]  = r^c  over GF(2^8)         (rows k+m, cols k)
+    encode    = vm @ inverse(vm[:k, :k])
+
+The top k rows of `encode` are the identity (systematic: data shards pass
+through); rows k..k+m-1 generate parity. Reproducing this construction —
+including the galExp(0,0)==1 convention — is what makes shards
+byte-identical to the Go reference.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import numpy as np
+
+from .gf256 import gf_exp, gf_mat_invert, gf_matmul
+
+MAX_SHARDS = 256  # k + m <= 256 (ref cmd/erasure-coding.go:41)
+
+
+def vandermonde(rows: int, cols: int) -> np.ndarray:
+    out = np.zeros((rows, cols), dtype=np.uint8)
+    for r in range(rows):
+        for c in range(cols):
+            out[r, c] = gf_exp(r, c)
+    return out
+
+
+@lru_cache(maxsize=None)
+def _encode_matrix_cached(data_shards: int, parity_shards: int) -> np.ndarray:
+    total = data_shards + parity_shards
+    if data_shards <= 0 or parity_shards <= 0:
+        raise ValueError("data and parity shard counts must be positive")
+    if total > MAX_SHARDS:
+        raise ValueError(f"too many shards: {total} > {MAX_SHARDS}")
+    vm = vandermonde(total, data_shards)
+    top_inv = gf_mat_invert(vm[:data_shards, :data_shards])
+    enc = gf_matmul(vm, top_inv)
+    enc.setflags(write=False)
+    return enc
+
+
+def encode_matrix(data_shards: int, parity_shards: int) -> np.ndarray:
+    """Full (k+m, k) systematic encoding matrix. Top k rows are identity."""
+    return _encode_matrix_cached(data_shards, parity_shards)
+
+
+def parity_matrix(data_shards: int, parity_shards: int) -> np.ndarray:
+    """The (m, k) parity-generating rows."""
+    return encode_matrix(data_shards, parity_shards)[data_shards:]
+
+
+def decode_matrix(data_shards: int, parity_shards: int,
+                  available: list[int]) -> tuple[np.ndarray, list[int]]:
+    """Build the data-reconstruction matrix for a given availability set.
+
+    `available` lists the shard indices (0..k+m-1) that are present. Following
+    the reference dependency's ReconstructData: take the FIRST k available
+    shards in index order, gather their rows of the encode matrix, invert.
+    Row r of the returned (k, k) matrix reconstructs data shard r from those
+    k survivor shards.
+
+    Returns (data_decode_matrix, used_shard_indices).
+    """
+    if len(available) < data_shards:
+        raise ValueError(
+            f"need at least {data_shards} shards, have {len(available)}")
+    enc = encode_matrix(data_shards, parity_shards)
+    used = sorted(available)[:data_shards]
+    sub = enc[used, :]
+    return gf_mat_invert(sub), used

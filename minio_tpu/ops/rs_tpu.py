@@ -1,0 +1,129 @@
+"""TPU-native Reed-Solomon: GF(2^8) linear maps as MXU bit-plane matmuls.
+
+Design (TPU-first, NOT a port of the reference's SIMD table lookups):
+
+GF(2^8) multiplication by a constant is GF(2)-linear in the bits of the
+input byte. An (r, k) GF(2^8) matrix therefore lowers to an (8r, 8k) 0/1
+matrix over GF(2) (gf256.gf_matrix_to_bitplane). Applying it to shard bytes
+becomes:
+
+    unpack bytes -> bit-planes        (k, S) u8  -> (8k, S) bf16
+    parity_bits  = (BigM @ bits) & 1  MXU matmul, f32 accumulation (exact:
+                                      popcount <= 8k <= 2048 < 2^24)
+    pack bit-planes -> bytes          (8m, S) -> (m, S) u8
+
+The whole encode is one batched matmul — large, static-shaped, bf16: exactly
+what the MXU wants. Reconstruction is the same kernel with a different
+(host-inverted, see rs_matrix.decode_matrix) matrix, so a single compiled
+function serves encode, reconstruct, and heal; the matrix is a runtime
+argument and never triggers recompilation.
+
+Batching: callers coalesce many blocks into (B, k, S) before dispatch
+(ops/batching.py); the grid then has B*ceil(S/tile) independent tiles.
+
+Reference parity points: cmd/erasure-coding.go:70 (EncodeData),
+:89 (DecodeDataBlocks); shard bytes are byte-identical to the Go encoder
+because the matrices come from rs_matrix (same construction).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .gf256 import gf_matrix_to_bitplane
+from .rs_matrix import decode_matrix, parity_matrix
+
+# --- host-side matrix prep ----------------------------------------------------
+
+
+@lru_cache(maxsize=None)
+def parity_bitplane(k: int, m: int) -> np.ndarray:
+    """(8m, 8k) bf16 bit-plane matrix generating parity from data shards."""
+    return gf_matrix_to_bitplane(parity_matrix(k, m)).astype(np.float32)
+
+
+@lru_cache(maxsize=1024)
+def decode_bitplane(k: int, m: int, available: tuple[int, ...],
+                    missing: tuple[int, ...]) -> tuple[np.ndarray, list[int]]:
+    """Bit-plane matrix rebuilding `missing` data shards from survivors.
+
+    Returns (bitplane_matrix (8*len(missing), 8k), used_shard_indices).
+    """
+    dec, used = decode_matrix(k, m, list(available))
+    rows = dec[list(missing), :]
+    return gf_matrix_to_bitplane(rows).astype(np.float32), used
+
+
+# --- device kernel ------------------------------------------------------------
+
+
+def _unpack_bits(x: jnp.ndarray) -> jnp.ndarray:
+    """(..., k, S) uint8 -> (..., 8k, S) bf16 bit-planes (LSB-first)."""
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    # (..., k, 8, S)
+    bits = (x[..., :, None, :] >> shifts[None, :, None]) & jnp.uint8(1)
+    shape = bits.shape[:-3] + (bits.shape[-3] * 8, bits.shape[-1])
+    return bits.reshape(shape).astype(jnp.bfloat16)
+
+
+def _pack_bits(bits: jnp.ndarray) -> jnp.ndarray:
+    """(..., 8m, S) int32 0/1 -> (..., m, S) uint8."""
+    shape = bits.shape[:-2] + (bits.shape[-2] // 8, 8, bits.shape[-1])
+    b = bits.reshape(shape)
+    weights = (1 << jnp.arange(8, dtype=jnp.int32))[None, :, None]
+    return jnp.sum(b * weights, axis=-2).astype(jnp.uint8)
+
+
+@jax.jit
+def gf_apply(big_m: jnp.ndarray, shards: jnp.ndarray) -> jnp.ndarray:
+    """Apply a bit-plane GF matrix to shard bytes.
+
+    big_m:  (8r, 8k) float/bf16 0/1 matrix (from parity_bitplane /
+            decode_bitplane).
+    shards: (..., k, S) uint8.
+    Returns (..., r, S) uint8.
+    """
+    bits = _unpack_bits(shards)
+    acc = jnp.matmul(big_m.astype(jnp.bfloat16), bits,
+                     preferred_element_type=jnp.float32)
+    out_bits = acc.astype(jnp.int32) & 1
+    return _pack_bits(out_bits)
+
+
+@jax.jit
+def encode_blocks(big_m: jnp.ndarray, data: jnp.ndarray) -> jnp.ndarray:
+    """Batched encode: (..., k, S) data shards -> (..., k+m, S) all shards."""
+    parity = gf_apply(big_m, data)
+    return jnp.concatenate([data, parity], axis=-2)
+
+
+# --- convenience host API -----------------------------------------------------
+
+
+def encode_batch(data: np.ndarray, k: int, m: int) -> np.ndarray:
+    """Encode a (B, k, S) or (k, S) uint8 batch on the default device."""
+    bm = jnp.asarray(parity_bitplane(k, m))
+    return np.asarray(encode_blocks(bm, jnp.asarray(data)))
+
+
+def reconstruct_batch(shards: np.ndarray, k: int, m: int,
+                      available: tuple[int, ...],
+                      missing: tuple[int, ...]) -> np.ndarray:
+    """Rebuild `missing` data shards for a batch sharing one erasure mask.
+
+    shards: (B, n_avail, S) uint8 — ONLY the survivor shards actually used,
+    i.e. the first k available in index order (see decode_bitplane's `used`).
+    Returns (B, len(missing), S) rebuilt shards.
+
+    Batches are grouped by mask on the host (ops/batching.py) so each device
+    call has a single dense matrix — SURVEY §7 hard part (f).
+    """
+    bm, used = decode_bitplane(k, m, available, missing)
+    if shards.shape[-2] != len(used):
+        raise ValueError(
+            f"expected {len(used)} survivor shards, got {shards.shape[-2]}")
+    return np.asarray(gf_apply(jnp.asarray(bm), jnp.asarray(shards)))

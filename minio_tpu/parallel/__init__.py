@@ -1,0 +1,2 @@
+"""Mesh/sharding machinery (device parallelism) and host-side quorum
+parallelism (thread-pool fan-out with write/read quorum semantics)."""

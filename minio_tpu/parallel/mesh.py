@@ -1,0 +1,50 @@
+"""Device-mesh parallelism for the erasure data plane.
+
+The object store's parallel axes (SURVEY §2.6 parallelism inventory) map to
+a 2-D device mesh:
+
+- 'blocks' (≈DP): independent 10MiB-stripe blocks from concurrent PUTs/heals
+  batch along the leading axis — embarrassingly parallel.
+- 'lanes'  (≈TP): shard bytes (the S axis). Every GF(2^8) op is elementwise
+  along S, so S shards cleanly with zero communication in encode/decode;
+  collectives only appear in integrity reductions (verify sums) and in
+  cross-host shard movement.
+
+Multi-chip hardware is not present in dev; shapes/shardings are validated on
+a virtual CPU mesh (tests) and via __graft_entry__.dryrun_multichip.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_devices: int | None = None,
+              axis_names: tuple[str, str] = ("blocks", "lanes"),
+              ) -> Mesh:
+    """Build a near-square 2-D mesh over the first n devices."""
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    devs = devs[:n]
+    # Factor n into (a, b) with a as large as possible <= sqrt-ish.
+    a = 1
+    for cand in range(int(math.isqrt(n)), 0, -1):
+        if n % cand == 0:
+            a = cand
+            break
+    import numpy as np
+    arr = np.array(devs).reshape(a, n // a)
+    return Mesh(arr, axis_names)
+
+
+def block_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for (B, k, S) shard-block batches: B over 'blocks', S over
+    'lanes', shard index replicated (each chip sees whole GF columns)."""
+    return NamedSharding(mesh, P("blocks", None, "lanes"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

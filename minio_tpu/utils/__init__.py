@@ -1,0 +1,8 @@
+"""Small shared helpers."""
+
+
+def ceil_frac(numerator: int, denominator: int) -> int:
+    """Ceiling division (ref cmd/utils.go ceilFrac)."""
+    if denominator == 0:
+        raise ZeroDivisionError("ceil_frac denominator is zero")
+    return -(-numerator // denominator)

@@ -1,0 +1,21 @@
+"""Test config: force JAX onto a virtual 8-device CPU platform.
+
+Multi-chip TPU hardware is not available in CI; sharding correctness is
+validated on a virtual CPU mesh (the driver separately dry-run-compiles the
+multi-chip path via __graft_entry__.dryrun_multichip). Must run before any
+jax import, hence top of conftest.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The container's sitecustomize force-registers the TPU ("axon") backend and
+# overrides JAX_PLATFORMS, so pin the config explicitly too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

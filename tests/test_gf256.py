@@ -1,0 +1,97 @@
+"""GF(2^8) arithmetic and matrix tests."""
+
+import numpy as np
+import pytest
+
+from minio_tpu.ops import gf256
+
+
+def test_exp_log_roundtrip():
+    for a in range(1, 256):
+        assert gf256.EXP_TABLE[gf256.LOG_TABLE[a]] == a
+
+
+def test_known_products():
+    # 2 * 0x80 = 0x100 mod 0x11D = 0x1D
+    assert gf256.gf_mul(2, 0x80) == 0x1D
+    assert gf256.gf_mul(0, 123) == 0
+    assert gf256.gf_mul(1, 123) == 123
+    # Commutativity + a few random associativity checks.
+    rng = np.random.default_rng(0)
+    for _ in range(100):
+        a, b, c = rng.integers(0, 256, 3)
+        assert gf256.gf_mul(a, b) == gf256.gf_mul(b, a)
+        assert gf256.gf_mul(gf256.gf_mul(a, b), c) == \
+            gf256.gf_mul(a, gf256.gf_mul(b, c))
+
+
+def test_distributivity():
+    rng = np.random.default_rng(1)
+    for _ in range(100):
+        a, b, c = rng.integers(0, 256, 3)
+        assert gf256.gf_mul(a, b ^ c) == gf256.gf_mul(a, b) ^ gf256.gf_mul(a, c)
+
+
+def test_inverse():
+    for a in range(1, 256):
+        assert gf256.gf_mul(a, gf256.gf_inv(a)) == 1
+    with pytest.raises(ZeroDivisionError):
+        gf256.gf_inv(0)
+
+
+def test_gf_exp_conventions():
+    # klauspost galExp conventions drive matrix bytes.
+    assert gf256.gf_exp(0, 0) == 1
+    assert gf256.gf_exp(0, 5) == 0
+    assert gf256.gf_exp(7, 0) == 1
+    assert gf256.gf_exp(2, 8) == 0x1D
+
+
+def test_matrix_inversion():
+    rng = np.random.default_rng(2)
+    for n in (1, 2, 4, 8, 12):
+        # Random invertible matrix: retry until nonsingular.
+        while True:
+            m = rng.integers(0, 256, (n, n)).astype(np.uint8)
+            try:
+                inv = gf256.gf_mat_invert(m)
+                break
+            except ValueError:
+                continue
+        prod = gf256.gf_matmul(m, inv)
+        assert np.array_equal(prod, np.eye(n, dtype=np.uint8))
+
+
+def test_singular_matrix_raises():
+    m = np.array([[1, 2], [1, 2]], dtype=np.uint8)
+    with pytest.raises(ValueError):
+        gf256.gf_mat_invert(m)
+
+
+def test_bitplane_lowering_matches_field_mul():
+    """y = M_c @ x_bits must equal c*x for every (c, x)."""
+    rng = np.random.default_rng(3)
+    for _ in range(50):
+        c = int(rng.integers(0, 256))
+        mat = gf256.gf_matrix_to_bitplane(np.array([[c]], dtype=np.uint8))
+        for x in rng.integers(0, 256, 8):
+            xbits = (int(x) >> np.arange(8)) & 1
+            ybits = (mat @ xbits) % 2
+            y = int((ybits << np.arange(8)).sum())
+            assert y == gf256.gf_mul(c, int(x)), (c, x)
+
+
+def test_bitplane_matrix_apply_matches_gf_matmul():
+    rng = np.random.default_rng(4)
+    k, r, s = 5, 3, 17
+    mat = rng.integers(0, 256, (r, k)).astype(np.uint8)
+    data = rng.integers(0, 256, (k, s)).astype(np.uint8)
+    want = gf256.gf_mat_vec_apply(mat, data)
+
+    big = gf256.gf_matrix_to_bitplane(mat)
+    bits = ((data[:, None, :] >> np.arange(8)[None, :, None]) & 1)
+    bits = bits.reshape(k * 8, s)
+    out_bits = (big.astype(np.int64) @ bits) % 2
+    out = (out_bits.reshape(r, 8, s) << np.arange(8)[None, :, None]).sum(
+        axis=1).astype(np.uint8)
+    assert np.array_equal(out, want)

@@ -1,0 +1,62 @@
+"""HighwayHash-256 tests.
+
+The load-bearing golden vector: the reference's magic bitrot key (ref
+cmd/bitrot.go:31) is documented as HH-256("first 100 decimals of pi",
+key=0) — computing it proves byte-identity with minio/highwayhash.
+"""
+
+import numpy as np
+
+from minio_tpu.ops import hh256
+
+
+def test_magic_key_golden_vector():
+    got = hh256.hh256(hh256.PI_100_DECIMALS.encode(), b"\x00" * 32)
+    assert got == hh256.MAGIC_KEY
+    assert hh256.MAGIC_KEY_SELF_TEST
+
+
+def test_empty_input():
+    # No golden vector; just determinism + correct size.
+    d = hh256.hh256(b"")
+    assert len(d) == 32
+    assert d == hh256.hh256(b"")
+
+
+def test_streaming_equals_oneshot():
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 256, 1000).astype(np.uint8).tobytes()
+    one = hh256.hh256(data)
+    h = hh256.HighwayHash256()
+    # Feed in awkward chunk sizes crossing packet boundaries.
+    i = 0
+    for n in (1, 31, 32, 33, 7, 64, 100, 500, 1000):
+        h.update(data[i:i + n])
+        i += n
+        if i >= len(data):
+            break
+    h.update(data[i:])
+    assert h.digest() == one
+
+
+def test_digest_idempotent():
+    h = hh256.HighwayHash256()
+    h.update(b"hello world")
+    assert h.digest() == h.digest()
+    h.update(b"!")
+    assert h.digest() == hh256.hh256(b"hello world!")
+
+
+def test_all_remainder_lengths():
+    # Exercise every size_mod32 branch (0..63 bytes).
+    seen = set()
+    for n in range(64):
+        d = hh256.hh256(bytes(range(n)))
+        assert len(d) == 32
+        assert d not in seen
+        seen.add(d)
+
+
+def test_key_sensitivity():
+    data = b"some data"
+    assert hh256.hh256(data, b"\x00" * 32) != hh256.hh256(data, b"\x01" * 32)
