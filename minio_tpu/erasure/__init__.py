@@ -1,0 +1,2 @@
+"""Erasure-coded object engine: codec orchestration, bitrot protection,
+metadata quorum, parallel shard I/O, healing."""

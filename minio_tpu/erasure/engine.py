@@ -1,0 +1,494 @@
+"""ErasureObjects — one erasure set's object engine.
+
+The analog of the reference's erasureObjects (ref cmd/erasure.go:48,
+cmd/erasure-object.go): quorum metadata read/write, shard I/O
+orchestration over StorageAPI disks, encode via the TPU codec, bitrot
+wrap/verify, degraded reads with reconstruction.
+
+Write path (ref putObject, cmd/erasure-object.go:582 / call stack §3.2):
+    split blocks -> batched encode (TPU) -> bitrot-wrap shard streams ->
+    parallel tmp write on all disks (write-quorum tolerant) ->
+    rename_data commit (atomic per disk, quorum again).
+
+Read path (ref getObjectWithFileInfo, cmd/erasure-object.go:240):
+    read xl.meta all disks -> FileInfo quorum -> read k shards
+    (first-k-wins with fallback to parity disks) -> reconstruct missing ->
+    join + trim.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import uuid
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..parallel.quorum import (QuorumError, hash_order, parallel_map,
+                               read_quorum, reduce_quorum_errs, write_quorum)
+from ..storage import errors as serr
+from ..storage.interface import StorageAPI
+from ..storage.metadata import (ErasureInfo, FileInfo, ObjectPartInfo,
+                                new_data_dir, new_version_id, now)
+from ..storage.xl import MINIO_META_BUCKET
+from ..utils import ceil_frac
+from . import bitrot
+from .codec import BLOCK_SIZE, Erasure
+
+TMP_PATH = "tmp"
+
+
+class ObjectNotFound(Exception):
+    pass
+
+
+class BucketNotFound(Exception):
+    pass
+
+
+class BucketExists(Exception):
+    pass
+
+
+@dataclass
+class ObjectInfo:
+    bucket: str
+    name: str
+    size: int = 0
+    etag: str = ""
+    mod_time: float = 0.0
+    version_id: str = ""
+    delete_marker: bool = False
+    metadata: dict = field(default_factory=dict)
+    parts: list[ObjectPartInfo] = field(default_factory=list)
+
+    @classmethod
+    def from_file_info(cls, fi: FileInfo) -> "ObjectInfo":
+        return cls(bucket=fi.volume, name=fi.name, size=fi.size,
+                   etag=fi.metadata.get("etag", ""), mod_time=fi.mod_time,
+                   version_id=fi.version_id, delete_marker=fi.deleted,
+                   metadata=dict(fi.metadata), parts=list(fi.parts))
+
+
+class ErasureObjects:
+    """Object engine over one erasure set of k+m disks."""
+
+    def __init__(self, disks: list[StorageAPI],
+                 data_shards: int | None = None,
+                 parity_shards: int | None = None,
+                 block_size: int = BLOCK_SIZE):
+        n = len(disks)
+        if n < 2:
+            raise ValueError("an erasure set needs >= 2 disks")
+        if data_shards is None:
+            # Default split: half data, half parity (ref default
+            # storage-class N/2:N/2, cmd/config/storageclass).
+            parity_shards = n // 2
+            data_shards = n - parity_shards
+        elif parity_shards is None:
+            parity_shards = n - data_shards
+        if data_shards + parity_shards != n:
+            raise ValueError("k + m must equal the number of disks")
+        self.disks = list(disks)
+        self.k = data_shards
+        self.m = parity_shards
+        self.block_size = block_size
+        self.codec = Erasure(data_shards, parity_shards, block_size)
+
+    # ------------------------------------------------------------------
+    # buckets
+
+    def make_bucket(self, bucket: str) -> None:
+        _, errs = parallel_map(
+            [lambda d=d: d.make_volume(bucket) for d in self.disks])
+        if any(isinstance(e, serr.VolumeExists) for e in errs):
+            # Exists on some disk: treat as exists (heal converges later).
+            if all(e is None or isinstance(e, serr.VolumeExists)
+                   for e in errs):
+                raise BucketExists(bucket)
+        try:
+            reduce_quorum_errs(errs, len(self.disks) // 2 + 1, "make_bucket")
+        except QuorumError:
+            # Roll back partial creates.
+            parallel_map([lambda d=d: d.delete_volume(bucket, force=True)
+                          for d, e in zip(self.disks, errs) if e is None])
+            raise
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        _, errs = parallel_map(
+            [lambda d=d: d.delete_volume(bucket, force=force)
+             for d in self.disks])
+        if any(isinstance(e, serr.VolumeExists) for e in errs):
+            raise BucketExists(f"{bucket} not empty")
+        if all(isinstance(e, serr.VolumeNotFound) for e in errs):
+            raise BucketNotFound(bucket)
+        reduce_quorum_errs(errs, len(self.disks) // 2 + 1, "delete_bucket")
+
+    def list_buckets(self) -> list[dict]:
+        for disk in self.disks:
+            try:
+                vols = disk.list_volumes()
+                return [disk.stat_volume(v) for v in vols]
+            except serr.StorageError:
+                continue
+        return []
+
+    def bucket_exists(self, bucket: str) -> bool:
+        """True if any reachable disk has the bucket and no not-found
+        majority exists (reads tolerate offline disks; ref getBucketInfo
+        first-healthy-disk semantics, cmd/erasure-bucket.go)."""
+        _, errs = parallel_map(
+            [lambda d=d: d.stat_volume(bucket) for d in self.disks])
+        ok = sum(1 for e in errs if e is None)
+        not_found = sum(1 for e in errs
+                        if isinstance(e, serr.VolumeNotFound))
+        return ok >= 1 and not_found <= len(self.disks) // 2
+
+    def _check_bucket(self, bucket: str) -> None:
+        if bucket == MINIO_META_BUCKET:
+            return
+        if not self.bucket_exists(bucket):
+            raise BucketNotFound(bucket)
+
+    # ------------------------------------------------------------------
+    # write path
+
+    def put_object(self, bucket: str, object_name: str, data: bytes,
+                   metadata: dict | None = None,
+                   versioned: bool = False) -> ObjectInfo:
+        self._check_bucket(bucket)
+        data = bytes(data)
+        n = len(self.disks)
+        distribution = hash_order(f"{bucket}/{object_name}", n)
+        wq = write_quorum(self.k, self.m)
+
+        shard_streams = self._encode_object(data)
+
+        version_id = new_version_id() if versioned else ""
+        data_dir = new_data_dir()
+        tmp_id = str(uuid.uuid4())
+        mod_time = now()
+        etag = hashlib.md5(data).hexdigest()
+        meta = dict(metadata or {})
+        meta["etag"] = etag
+
+        part = ObjectPartInfo(number=1, size=len(data),
+                              actual_size=len(data), etag=etag)
+
+        def write_one(i: int):
+            disk = self.disks[i]
+            shard_idx = distribution[i] - 1
+            tmp_path = f"{TMP_PATH}/{tmp_id}"
+            if len(data) > 0:
+                disk.create_file(MINIO_META_BUCKET,
+                                 f"{tmp_path}/{data_dir}/part.1",
+                                 shard_streams[shard_idx])
+            fi = FileInfo(
+                volume=bucket, name=object_name, version_id=version_id,
+                data_dir=data_dir if len(data) > 0 else "",
+                size=len(data), mod_time=mod_time, metadata=meta,
+                parts=[part],
+                erasure=ErasureInfo(
+                    data_blocks=self.k, parity_blocks=self.m,
+                    block_size=self.block_size, index=distribution[i],
+                    distribution=list(distribution),
+                    checksums=[{"part": 1,
+                                "algorithm": bitrot.DEFAULT_ALGORITHM,
+                                "hash": ""}],
+                ),
+            )
+            disk.rename_data(MINIO_META_BUCKET, tmp_path, fi,
+                             bucket, object_name)
+            return fi
+
+        _, errs = parallel_map(
+            [lambda i=i: write_one(i) for i in range(n)])
+        reduce_quorum_errs(errs, wq, "put_object")
+        # Partial failures feed the MRF heal queue (ref addPartial,
+        # cmd/erasure-object.go:1082) — wired when healing lands.
+        return ObjectInfo(bucket=bucket, name=object_name, size=len(data),
+                          etag=etag, mod_time=mod_time,
+                          version_id=version_id, metadata=meta,
+                          parts=[part])
+
+    def _encode_object(self, data: bytes) -> list[bytes]:
+        """Encode all stripe blocks (batched TPU dispatch for the full
+        blocks) and return the k+m bitrot-wrapped shard streams."""
+        n = self.k + self.m
+        if len(data) == 0:
+            return [b""] * n
+        shard_size = self.codec.shard_size()
+        raw_shards: list[bytearray] = [bytearray() for _ in range(n)]
+
+        nfull = len(data) // self.block_size
+        if nfull:
+            # One batched device dispatch for all full blocks. Each block is
+            # zero-padded to k*shard_size (split padding semantics, ref
+            # dependency Split of cmd/erasure-coding.go:74).
+            full = np.frombuffer(
+                data[:nfull * self.block_size], dtype=np.uint8,
+            ).reshape(nfull, self.block_size)
+            if self.block_size != self.k * shard_size:
+                padded = np.zeros((nfull, self.k * shard_size),
+                                  dtype=np.uint8)
+                padded[:, :self.block_size] = full
+                full = padded
+            full = full.reshape(nfull, self.k, shard_size)
+            encoded = self.codec.encode_blocks_batch(full)
+            for j in range(n):
+                raw_shards[j] += encoded[:, j, :].tobytes()
+        rest = data[nfull * self.block_size:]
+        if rest:
+            shards = self.codec.encode_data(rest)
+            for j in range(n):
+                raw_shards[j] += shards[j].tobytes()
+
+        return [bitrot.encode_stream(bytes(s), shard_size)
+                for s in raw_shards]
+
+    # ------------------------------------------------------------------
+    # read path
+
+    def _read_file_infos(self, bucket: str, object_name: str,
+                         version_id: str = "",
+                         ) -> tuple[list[FileInfo | None], list]:
+        results, errs = parallel_map(
+            [lambda d=d: d.read_version(bucket, object_name, version_id)
+             for d in self.disks])
+        fis = [r if e is None else None for r, e in zip(results, errs)]
+        return fis, errs
+
+    def _quorum_file_info(self, bucket: str, object_name: str,
+                          version_id: str = "",
+                          ) -> tuple[FileInfo, list[FileInfo | None]]:
+        """FileInfo agreed by >= read-quorum disks (ref
+        findFileInfoInQuorum, cmd/erasure-metadata.go)."""
+        fis, errs = self._read_file_infos(bucket, object_name, version_id)
+        if all(f is None for f in fis):
+            if any(isinstance(e, serr.VersionNotFound) for e in errs):
+                raise ObjectNotFound(f"{bucket}/{object_name}@{version_id}")
+            raise ObjectNotFound(f"{bucket}/{object_name}")
+        groups: dict[tuple, list[int]] = {}
+        for i, fi in enumerate(fis):
+            if fi is not None:
+                groups.setdefault(fi.quorum_key(), []).append(i)
+        key, members = max(groups.items(), key=lambda kv: len(kv[1]))
+        fi = fis[members[0]]
+        rq = read_quorum(fi.erasure.data_blocks or self.k)
+        if len(members) < rq:
+            raise QuorumError(
+                f"metadata quorum not met for {bucket}/{object_name} "
+                f"({len(members)}/{len(self.disks)}, need {rq})",
+                list(errs))
+        # Null out disks outside the quorum group.
+        agreed = [fis[i] if i in members else None
+                  for i in range(len(fis))]
+        return fi, agreed
+
+    def get_object_info(self, bucket: str, object_name: str,
+                        version_id: str = "") -> ObjectInfo:
+        self._check_bucket(bucket)
+        fi, _ = self._quorum_file_info(bucket, object_name, version_id)
+        if fi.deleted:
+            raise ObjectNotFound(f"{bucket}/{object_name}")
+        return ObjectInfo.from_file_info(fi)
+
+    def get_object(self, bucket: str, object_name: str, offset: int = 0,
+                   length: int = -1, version_id: str = "",
+                   ) -> tuple[bytes, ObjectInfo]:
+        self._check_bucket(bucket)
+        fi, agreed = self._quorum_file_info(bucket, object_name, version_id)
+        if fi.deleted:
+            raise ObjectNotFound(f"{bucket}/{object_name}")
+        info = ObjectInfo.from_file_info(fi)
+        if offset < 0 or offset > fi.size:
+            raise ValueError("invalid range")
+        if length < 0:
+            length = fi.size - offset
+        if offset + length > fi.size:
+            raise ValueError("invalid range")
+        if length == 0 or fi.size == 0:
+            return b"", info
+        data = self._read_and_decode(fi, agreed, offset, length)
+        return data, info
+
+    def _shard_readers(self, fi: FileInfo,
+                       agreed: list[FileInfo | None]) -> list[int | None]:
+        """Map shard index j (0-based) -> disk position, using each disk's
+        own erasure.index from its metadata."""
+        n = self.k + self.m
+        by_shard: list[int | None] = [None] * n
+        for i, f in enumerate(agreed):
+            if f is not None and 1 <= f.erasure.index <= n:
+                by_shard[f.erasure.index - 1] = i
+        return by_shard
+
+    def _read_and_decode(self, fi: FileInfo,
+                         agreed: list[FileInfo | None],
+                         offset: int, length: int) -> bytes:
+        k, m = fi.erasure.data_blocks, fi.erasure.parity_blocks
+        shard_size = fi.erasure.shard_size()
+        by_shard = self._shard_readers(fi, agreed)
+        part_size = fi.parts[0].size if fi.parts else fi.size
+
+        # Block coverage of [offset, offset+length).
+        start_block = offset // fi.erasure.block_size
+        end_block = (offset + length - 1) // fi.erasure.block_size
+        n_cov = end_block - start_block + 1
+
+        # Ranged shard-file window: each full block contributes
+        # [hash][shard_size] to the stream, so blocks [b0, b1] live at
+        # byte offset b0*stride, length <= n_cov*stride (short at EOF for
+        # the last block; ref streamingBitrotReader stream offset math,
+        # cmd/bitrot-streaming.go:125).
+        hsz = bitrot.hash_size(bitrot.DEFAULT_ALGORITHM)
+        stride = hsz + shard_size
+        win_off = start_block * stride
+
+        windows: dict[int, bytes] = {}
+        failed: set[int] = set()
+
+        def fetch(j: int) -> bool:
+            """Fetch shard j's stream window; False if unavailable."""
+            if j in windows:
+                return True
+            if j in failed or by_shard[j] is None:
+                return False
+            disk = self.disks[by_shard[j]]
+            f = agreed[by_shard[j]]
+            try:
+                windows[j] = disk.read_file(
+                    fi.volume, f"{fi.name}/{f.data_dir}/part.1",
+                    win_off, n_cov * stride)
+                return True
+            except Exception:
+                failed.add(j)
+                return False
+
+        # First-k-wins: fire the k data-shard reads in parallel, fall back
+        # to parity serially (ref parallelReader, cmd/erasure-decode.go:104).
+        candidates = list(range(k)) + list(range(k, k + m))
+        parallel_map([lambda j=j: fetch(j) for j in range(k)])
+        have = [j for j in candidates if j in windows]
+        for j in candidates:
+            if len(have) >= k:
+                break
+            if j not in have and fetch(j):
+                have.append(j)
+        if len(have) < k:
+            raise QuorumError(
+                f"read quorum not met: only {len(have)}/{k} shards readable",
+                [])
+
+        def block_chunk(j: int, local: int, chunk: int) -> bytes:
+            """Extract + bitrot-verify one block's chunk from shard j's
+            window; raises BitrotMismatch."""
+            base = local * stride
+            win = windows[j]
+            want = win[base:base + hsz]
+            data = win[base + hsz:base + hsz + chunk]
+            if len(want) < hsz or len(data) < chunk:
+                raise bitrot.BitrotMismatch("truncated shard stream")
+            if bitrot.digest(bitrot.DEFAULT_ALGORITHM, data) != want:
+                raise bitrot.BitrotMismatch(
+                    f"content hash mismatch (shard {j})")
+            return data
+
+        out = bytearray()
+        for b in range(start_block, end_block + 1):
+            blk_len = (min(fi.erasure.block_size,
+                           part_size - b * fi.erasure.block_size))
+            chunk = ceil_frac(blk_len, k)
+            # Gather this block's chunk from k shards, verify bitrot,
+            # reconstruct on mismatch/loss.
+            shards: list[np.ndarray | None] = [None] * (k + m)
+            good = 0
+            for j in list(have) + [j for j in candidates if j not in have]:
+                if good >= k:
+                    break
+                if not fetch(j):
+                    continue
+                try:
+                    raw = block_chunk(j, b - start_block, chunk)
+                    shards[j] = np.frombuffer(raw, dtype=np.uint8)
+                    good += 1
+                except bitrot.BitrotMismatch:
+                    failed.add(j)
+                    windows.pop(j, None)
+                    if j in have:
+                        have.remove(j)
+                    # heal required — signaled to the heal queue later
+            if good < k:
+                raise QuorumError(
+                    f"block {b}: only {good}/{k} shards valid", [])
+            decoded = self.codec.decode_data_blocks(shards) \
+                if any(shards[j] is None for j in range(k)) else shards
+            block_data = b"".join(
+                decoded[j].tobytes() for j in range(k))[:blk_len]
+            out += block_data
+        # Trim to the requested range within covered blocks.
+        skip = offset - start_block * fi.erasure.block_size
+        return bytes(out[skip:skip + length])
+
+    # ------------------------------------------------------------------
+    # delete / list
+
+    def delete_object(self, bucket: str, object_name: str,
+                      version_id: str = "") -> None:
+        self._check_bucket(bucket)
+        fi = FileInfo(volume=bucket, name=object_name,
+                      version_id=version_id)
+        _, errs = parallel_map(
+            [lambda d=d: d.delete_version(bucket, object_name, fi)
+             for d in self.disks])
+        not_found = sum(1 for e in errs if isinstance(
+            e, (serr.FileNotFound, serr.VersionNotFound)))
+        if not_found == len(self.disks):
+            raise ObjectNotFound(f"{bucket}/{object_name}")
+        reduce_quorum_errs(
+            [None if isinstance(e, (serr.FileNotFound,
+                                    serr.VersionNotFound)) else e
+             for e in errs],
+            write_quorum(self.k, self.m), "delete_object")
+
+    def list_objects(self, bucket: str, prefix: str = "",
+                     max_keys: int = 1000) -> list[ObjectInfo]:
+        """Union-merge directory walk across disks, quorum-stat each object
+        (the metacache engine replaces this for scale)."""
+        self._check_bucket(bucket)
+        names: set[str] = set()
+
+        def walk(disk: StorageAPI, path: str) -> None:
+            try:
+                entries = disk.list_dir(bucket, path)
+            except serr.StorageError:
+                return
+            if "xl.meta" in entries:
+                names.add(path)
+                return
+            for e in entries:
+                if e.endswith("/"):
+                    walk(disk, f"{path}{e}" if path else e)
+
+        # Union across every disk so objects thin on some disks (partial
+        # writes within quorum) still list.
+        for disk in self.disks:
+            try:
+                base_entries = disk.list_dir(bucket, "")
+            except serr.StorageError:
+                continue
+            for e in base_entries:
+                if e.endswith("/"):
+                    walk(disk, e)
+
+        out = []
+        for name in sorted(n.rstrip("/") for n in names):
+            if prefix and not name.startswith(prefix):
+                continue
+            try:
+                out.append(self.get_object_info(bucket, name))
+            except (ObjectNotFound, QuorumError):
+                continue
+            if len(out) >= max_keys:
+                break
+        return out

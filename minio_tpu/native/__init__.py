@@ -1,0 +1,82 @@
+"""Native (C++) host-side kernels, built on demand with g++ via ctypes.
+
+The reference delegates its host hot loops to SIMD assembly libraries
+(SURVEY §2.7). Here the host fallback/cryptographic loops live in C++
+compiled once into a shared object under build/; the TPU kernels remain the
+primary data plane. Everything degrades gracefully to pure Python if a
+compiler is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_HERE, "build")
+_LOCK = threading.Lock()
+_LIB: ctypes.CDLL | None = None
+_TRIED = False
+
+
+def _build_and_load() -> ctypes.CDLL | None:
+    src = os.path.join(_HERE, "highwayhash.cc")
+    so = os.path.join(_BUILD_DIR, "libminio_tpu_native.so")
+    try:
+        if (not os.path.exists(so)
+                or os.path.getmtime(so) < os.path.getmtime(src)):
+            os.makedirs(_BUILD_DIR, exist_ok=True)
+            tmp = so + ".tmp"
+            subprocess.run(
+                ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+                 "-o", tmp, src],
+                check=True, capture_output=True, timeout=120)
+            os.replace(tmp, so)
+        lib = ctypes.CDLL(so)
+        lib.hh256_hash.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                   ctypes.c_size_t, ctypes.c_char_p]
+        lib.hh256_hash.restype = None
+        lib.hh256_chunks.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                     ctypes.c_size_t, ctypes.c_size_t,
+                                     ctypes.c_char_p]
+        lib.hh256_chunks.restype = ctypes.c_size_t
+        return lib
+    except Exception:
+        return None
+
+
+def get_lib() -> ctypes.CDLL | None:
+    global _LIB, _TRIED
+    if _LIB is None and not _TRIED:
+        with _LOCK:
+            if _LIB is None and not _TRIED:
+                _LIB = _build_and_load()
+                _TRIED = True
+    return _LIB
+
+
+def hh256_native(data: bytes, key: bytes) -> bytes | None:
+    """One-shot HighwayHash-256 via C++; None if native lib unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    out = ctypes.create_string_buffer(32)
+    lib.hh256_hash(key, bytes(data), len(data), out)
+    return out.raw
+
+
+def hh256_chunks_native(data: bytes, chunk_size: int,
+                        key: bytes) -> list[bytes] | None:
+    """Hash consecutive chunk_size chunks (streaming-bitrot pattern)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    if len(data) == 0:
+        return []
+    n = -(-len(data) // chunk_size)
+    out = ctypes.create_string_buffer(32 * n)
+    got = lib.hh256_chunks(key, bytes(data), len(data), chunk_size, out)
+    assert got == n
+    return [out.raw[i * 32:(i + 1) * 32] for i in range(n)]

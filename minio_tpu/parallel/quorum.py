@@ -1,0 +1,78 @@
+"""Host-side quorum parallelism: thread-pool fan-out over disks with the
+reference's quorum-reduction semantics (ref cmd/erasure-metadata-utils.go
+reduceErrs, cmd/erasure-encode.go parallelWriter, pkg/dsync quorum math).
+"""
+
+from __future__ import annotations
+
+import zlib
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Sequence
+
+
+class QuorumError(Exception):
+    """Not enough disks agreed/succeeded."""
+
+    def __init__(self, message: str, errs: list[BaseException | None]):
+        super().__init__(message)
+        self.errs = errs
+
+
+def hash_order(key: str, cardinality: int) -> list[int]:
+    """Deterministic shard distribution for an object key: a rotation of
+    1..n starting at crc32(key) % n (ref hashOrder,
+    cmd/erasure-metadata-utils.go)."""
+    if cardinality <= 0:
+        return []
+    start = zlib.crc32(key.encode("utf-8")) % cardinality
+    # 1-based, starting at start+1 (ref loop i=1..n: 1 + (start+i) % n).
+    return [1 + (start + i) % cardinality for i in range(1, cardinality + 1)]
+
+
+def parallel_map(fns: Sequence[Callable[[], Any]],
+                 ) -> tuple[list[Any], list[BaseException | None]]:
+    """Run thunks concurrently; returns (results, errs) aligned by index.
+    A thunk that raises contributes (None, exception)."""
+    results: list[Any] = [None] * len(fns)
+    errs: list[BaseException | None] = [None] * len(fns)
+    if not fns:
+        return results, errs
+    with ThreadPoolExecutor(max_workers=max(1, len(fns))) as pool:
+        futures = {pool.submit(fn): i for i, fn in enumerate(fns)}
+        for fut, i in futures.items():
+            try:
+                results[i] = fut.result()
+            except BaseException as e:  # noqa: BLE001 — collected, reduced
+                errs[i] = e
+    return results, errs
+
+
+def count_errs(errs: Sequence[BaseException | None]) -> int:
+    return sum(1 for e in errs if e is not None)
+
+
+def reduce_quorum_errs(errs: Sequence[BaseException | None],
+                       quorum: int, op: str) -> None:
+    """Raise QuorumError unless at least `quorum` entries succeeded
+    (ref reduceWriteQuorumErrs / reduceReadQuorumErrs)."""
+    ok = len(errs) - count_errs(errs)
+    if ok < quorum:
+        detail = "; ".join(
+            f"disk{i}: {type(e).__name__}: {e}"
+            for i, e in enumerate(errs) if e is not None)
+        raise QuorumError(
+            f"{op}: quorum not met ({ok}/{len(errs)} ok, need {quorum}): "
+            f"{detail}", list(errs))
+
+
+def write_quorum(data_blocks: int, parity_blocks: int) -> int:
+    """Write quorum: k, +1 when k == m (ref cmd/erasure-object.go:604-608)."""
+    q = data_blocks
+    if data_blocks == parity_blocks:
+        q += 1
+    return q
+
+
+def read_quorum(data_blocks: int) -> int:
+    """Read quorum: k (ref cmd/erasure-object.go getReadQuorum)."""
+    return data_blocks

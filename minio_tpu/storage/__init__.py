@@ -1,0 +1,2 @@
+"""Per-disk storage: the StorageAPI contract, local POSIX implementation
+(xl-storage analog), and on-disk metadata formats."""

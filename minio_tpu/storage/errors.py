@@ -1,0 +1,37 @@
+"""Storage error taxonomy (ref cmd/storage-errors.go)."""
+
+
+class StorageError(Exception):
+    """Base class for per-disk storage errors."""
+
+
+class DiskNotFound(StorageError):
+    """Disk is offline or gone (ref errDiskNotFound)."""
+
+
+class FaultyDisk(StorageError):
+    """Disk returned an unexpected I/O error (ref errFaultyDisk)."""
+
+
+class VolumeNotFound(StorageError):
+    """Bucket/volume does not exist (ref errVolumeNotFound)."""
+
+
+class VolumeExists(StorageError):
+    """Volume already exists (ref errVolumeExists)."""
+
+
+class FileNotFound(StorageError):
+    """Object/file does not exist (ref errFileNotFound)."""
+
+
+class VersionNotFound(StorageError):
+    """Requested version does not exist (ref errFileVersionNotFound)."""
+
+
+class FileCorrupt(StorageError):
+    """File failed bitrot/format validation (ref errFileCorrupt)."""
+
+
+class DiskFull(StorageError):
+    """No space left (ref errDiskFull)."""

@@ -1,0 +1,111 @@
+"""StorageAPI — the per-disk contract (ref cmd/storage-interface.go:25-82).
+
+Every method has a local implementation (xl.XLStorage) and, in distributed
+mode, a remote one (rpc.RemoteStorage) with identical semantics. This seam
+is also the fault-injection point for tests (the reference's naughtyDisk
+pattern, ref cmd/naughty-disk_test.go).
+
+All data-plane payloads are bytes; erasure/bitrot logic lives above this
+layer. Errors are storage.errors types.
+"""
+
+from __future__ import annotations
+
+import abc
+
+from .metadata import FileInfo
+
+
+class StorageAPI(abc.ABC):
+    """30-method per-disk contract, grown as layers land."""
+
+    # --- identity / health ---
+
+    @abc.abstractmethod
+    def disk_info(self) -> dict:
+        """Totals/frees/id (ref DiskInfo)."""
+
+    def is_online(self) -> bool:
+        return True
+
+    def endpoint(self) -> str:
+        return "local"
+
+    def close(self) -> None:
+        pass
+
+    # --- volumes (buckets) ---
+
+    @abc.abstractmethod
+    def make_volume(self, volume: str) -> None: ...
+
+    @abc.abstractmethod
+    def list_volumes(self) -> list[str]: ...
+
+    @abc.abstractmethod
+    def stat_volume(self, volume: str) -> dict: ...
+
+    @abc.abstractmethod
+    def delete_volume(self, volume: str, force: bool = False) -> None: ...
+
+    # --- flat files (config, tmp shards) ---
+
+    @abc.abstractmethod
+    def write_all(self, volume: str, path: str, data: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def read_all(self, volume: str, path: str) -> bytes: ...
+
+    @abc.abstractmethod
+    def read_file(self, volume: str, path: str, offset: int,
+                  length: int) -> bytes:
+        """Ranged read (ref ReadFileStream)."""
+
+    @abc.abstractmethod
+    def create_file(self, volume: str, path: str, data: bytes) -> None:
+        """Write a (shard) file, creating parents (ref CreateFile)."""
+
+    @abc.abstractmethod
+    def delete(self, volume: str, path: str, recursive: bool = False,
+               ) -> None: ...
+
+    @abc.abstractmethod
+    def rename_file(self, src_volume: str, src_path: str, dst_volume: str,
+                    dst_path: str) -> None: ...
+
+    @abc.abstractmethod
+    def list_dir(self, volume: str, path: str) -> list[str]:
+        """Entries of a directory; dirs have a trailing '/'."""
+
+    # --- object versions (xl.meta) ---
+
+    @abc.abstractmethod
+    def rename_data(self, src_volume: str, src_path: str, fi: FileInfo,
+                    dst_volume: str, dst_path: str) -> None:
+        """Atomic object commit: move tmp data dir + merge version into
+        dst xl.meta (ref RenameData, cmd/xl-storage.go:1972)."""
+
+    @abc.abstractmethod
+    def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
+        """Merge one version into xl.meta (ref WriteMetadata)."""
+
+    @abc.abstractmethod
+    def read_version(self, volume: str, path: str,
+                     version_id: str = "") -> FileInfo:
+        """Read one version's FileInfo ("" = latest)
+        (ref ReadVersion)."""
+
+    @abc.abstractmethod
+    def delete_version(self, volume: str, path: str, fi: FileInfo) -> None:
+        """Remove a version; drops data dir when last reference goes
+        (ref DeleteVersion)."""
+
+    @abc.abstractmethod
+    def read_parts(self, volume: str, path: str, data_dir: str,
+                   ) -> list[str]:
+        """List part files of a version's data dir (ref CheckParts)."""
+
+    @abc.abstractmethod
+    def verify_file(self, volume: str, path: str, fi: FileInfo) -> None:
+        """Deep bitrot scan of this disk's shard for fi; raises
+        FileCorrupt on mismatch (ref VerifyFile, cmd/xl-storage.go:2380)."""

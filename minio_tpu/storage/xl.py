@@ -1,0 +1,338 @@
+"""XLStorage — local POSIX StorageAPI (ref cmd/xl-storage.go).
+
+On-disk layout per disk root (same shape as the reference):
+
+    <root>/.minio.sys/tmp/<uuid>/...       staging for in-flight writes
+    <root>/<bucket>/<object>/xl.meta       version metadata (JSON, metadata.py)
+    <root>/<bucket>/<object>/<dataDir>/part.N   bitrot-wrapped shard files
+
+Writes are crash-safe: tmp file + fsync-less atomic os.replace (the
+reference's reliable-rename pattern, cmd/os-reliable.go); object commit is
+rename_data (ref cmd/xl-storage.go:1972).
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import shutil
+import uuid
+
+from . import errors as serr
+from .interface import StorageAPI
+from .metadata import XL_META_FILE, FileInfo, XLMeta
+from ..erasure import bitrot
+
+MINIO_META_BUCKET = ".minio.sys"
+TMP_DIR = ".minio.sys/tmp"
+
+_RESERVED_VOLUMES = {MINIO_META_BUCKET}
+
+
+def _is_valid_volume(volume: str) -> bool:
+    return (volume not in ("", ".", "..") and "/" not in volume
+            and "\\" not in volume)
+
+
+class XLStorage(StorageAPI):
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self.disk_id = ""
+        os.makedirs(os.path.join(self.root, TMP_DIR), exist_ok=True)
+
+    def __repr__(self) -> str:
+        return f"XLStorage({self.root})"
+
+    # --- path helpers ---
+
+    def _vol_path(self, volume: str) -> str:
+        if not _is_valid_volume(volume) and volume != MINIO_META_BUCKET:
+            raise serr.VolumeNotFound(volume)
+        return os.path.join(self.root, volume)
+
+    def _file_path(self, volume: str, path: str) -> str:
+        base = self._vol_path(volume)
+        full = os.path.normpath(os.path.join(base, path))
+        if not full.startswith(base + os.sep) and full != base:
+            raise serr.FileNotFound(path)  # path traversal
+        return full
+
+    def _check_vol(self, volume: str) -> str:
+        p = self._vol_path(volume)
+        if not os.path.isdir(p):
+            raise serr.VolumeNotFound(volume)
+        return p
+
+    # --- identity / health ---
+
+    def disk_info(self) -> dict:
+        st = os.statvfs(self.root)
+        return {
+            "total": st.f_blocks * st.f_frsize,
+            "free": st.f_bavail * st.f_frsize,
+            "used": (st.f_blocks - st.f_bfree) * st.f_frsize,
+            "root": self.root,
+            "id": self.disk_id,
+        }
+
+    def endpoint(self) -> str:
+        return self.root
+
+    # --- volumes ---
+
+    def make_volume(self, volume: str) -> None:
+        if not _is_valid_volume(volume):
+            raise serr.VolumeNotFound(volume)
+        p = os.path.join(self.root, volume)
+        if os.path.isdir(p):
+            raise serr.VolumeExists(volume)
+        os.makedirs(p)
+
+    def list_volumes(self) -> list[str]:
+        out = []
+        for name in sorted(os.listdir(self.root)):
+            if name in _RESERVED_VOLUMES or name.startswith("."):
+                continue
+            if os.path.isdir(os.path.join(self.root, name)):
+                out.append(name)
+        return out
+
+    def stat_volume(self, volume: str) -> dict:
+        p = self._check_vol(volume)
+        st = os.stat(p)
+        return {"name": volume, "created": st.st_mtime}
+
+    def delete_volume(self, volume: str, force: bool = False) -> None:
+        p = self._check_vol(volume)
+        try:
+            if force:
+                shutil.rmtree(p)
+            else:
+                os.rmdir(p)
+        except OSError as e:
+            if e.errno == errno.ENOTEMPTY:
+                raise serr.VolumeExists(f"{volume} not empty")
+            raise serr.FaultyDisk(str(e))
+
+    # --- flat files ---
+
+    def _atomic_write(self, full: str, data: bytes) -> None:
+        os.makedirs(os.path.dirname(full), exist_ok=True)
+        tmp = os.path.join(self.root, TMP_DIR, str(uuid.uuid4()))
+        try:
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, full)
+        except OSError as e:
+            if e.errno == errno.ENOSPC:
+                raise serr.DiskFull(str(e))
+            raise serr.FaultyDisk(str(e))
+
+    def write_all(self, volume: str, path: str, data: bytes) -> None:
+        self._check_vol(volume)
+        self._atomic_write(self._file_path(volume, path), bytes(data))
+
+    def read_all(self, volume: str, path: str) -> bytes:
+        self._check_vol(volume)
+        full = self._file_path(volume, path)
+        try:
+            with open(full, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise serr.FileNotFound(f"{volume}/{path}")
+        except IsADirectoryError:
+            raise serr.FileNotFound(f"{volume}/{path}")
+        except OSError as e:
+            raise serr.FaultyDisk(str(e))
+
+    def read_file(self, volume: str, path: str, offset: int,
+                  length: int) -> bytes:
+        self._check_vol(volume)
+        full = self._file_path(volume, path)
+        try:
+            with open(full, "rb") as f:
+                f.seek(offset)
+                return f.read(length)
+        except FileNotFoundError:
+            raise serr.FileNotFound(f"{volume}/{path}")
+        except OSError as e:
+            raise serr.FaultyDisk(str(e))
+
+    def create_file(self, volume: str, path: str, data: bytes) -> None:
+        self._check_vol(volume)
+        self._atomic_write(self._file_path(volume, path), bytes(data))
+
+    def delete(self, volume: str, path: str, recursive: bool = False,
+               ) -> None:
+        self._check_vol(volume)
+        full = self._file_path(volume, path)
+        try:
+            if os.path.isdir(full):
+                if recursive:
+                    shutil.rmtree(full)
+                else:
+                    os.rmdir(full)
+            else:
+                os.remove(full)
+        except FileNotFoundError:
+            raise serr.FileNotFound(f"{volume}/{path}")
+        except OSError as e:
+            raise serr.FaultyDisk(str(e))
+        # Prune now-empty parent dirs up to the volume root (the reference
+        # deletes parent prefixes as they empty).
+        parent = os.path.dirname(full)
+        vol = self._vol_path(volume)
+        while parent != vol:
+            try:
+                os.rmdir(parent)
+            except OSError:
+                break
+            parent = os.path.dirname(parent)
+
+    def rename_file(self, src_volume: str, src_path: str, dst_volume: str,
+                    dst_path: str) -> None:
+        self._check_vol(src_volume)
+        self._check_vol(dst_volume)
+        src = self._file_path(src_volume, src_path)
+        dst = self._file_path(dst_volume, dst_path)
+        if not os.path.exists(src):
+            raise serr.FileNotFound(f"{src_volume}/{src_path}")
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        try:
+            os.replace(src, dst)
+        except OSError as e:
+            raise serr.FaultyDisk(str(e))
+
+    def list_dir(self, volume: str, path: str) -> list[str]:
+        self._check_vol(volume)
+        full = self._file_path(volume, path) if path else self._vol_path(
+            volume)
+        try:
+            out = []
+            for name in sorted(os.listdir(full)):
+                if os.path.isdir(os.path.join(full, name)):
+                    out.append(name + "/")
+                else:
+                    out.append(name)
+            return out
+        except FileNotFoundError:
+            raise serr.FileNotFound(f"{volume}/{path}")
+        except NotADirectoryError:
+            raise serr.FileNotFound(f"{volume}/{path}")
+
+    # --- object versions ---
+
+    def _read_xlmeta(self, volume: str, path: str) -> XLMeta:
+        raw = self.read_all(volume, os.path.join(path, XL_META_FILE))
+        try:
+            return XLMeta.load(raw)
+        except ValueError as e:
+            raise serr.FileCorrupt(str(e))
+
+    def _write_xlmeta(self, volume: str, path: str, meta: XLMeta) -> None:
+        self._atomic_write(
+            self._file_path(volume, os.path.join(path, XL_META_FILE)),
+            meta.dump())
+
+    def rename_data(self, src_volume: str, src_path: str, fi: FileInfo,
+                    dst_volume: str, dst_path: str) -> None:
+        """Commit: move <src>/<dataDir> under dst object dir, then merge
+        fi as a version into dst xl.meta (ref cmd/xl-storage.go:1972)."""
+        self._check_vol(src_volume)
+        self._check_vol(dst_volume)
+        dst_obj_dir = self._file_path(dst_volume, dst_path)
+        os.makedirs(dst_obj_dir, exist_ok=True)
+        if fi.data_dir:
+            src_dd = self._file_path(src_volume,
+                                     os.path.join(src_path, fi.data_dir))
+            dst_dd = os.path.join(dst_obj_dir, fi.data_dir)
+            if not os.path.isdir(src_dd):
+                raise serr.FileNotFound(f"{src_volume}/{src_path}")
+            if os.path.isdir(dst_dd):
+                shutil.rmtree(dst_dd)
+            os.replace(src_dd, dst_dd)
+        try:
+            meta = self._read_xlmeta(dst_volume, dst_path)
+        except serr.FileNotFound:
+            meta = XLMeta()
+        # Null-version overwrite frees the PREVIOUS NULL version's data dir
+        # only (real versions keep theirs; ref xlMetaV2.AddVersion null-
+        # version replacement semantics).
+        old = None
+        if fi.version_id == "":
+            for v in meta.versions:
+                if v.get("versionId", "") == "":
+                    old = v
+                    break
+        if old and old.get("dataDir") and old["dataDir"] != fi.data_dir:
+            old_dd = os.path.join(dst_obj_dir, old["dataDir"])
+            if os.path.isdir(old_dd):
+                shutil.rmtree(old_dd, ignore_errors=True)
+        meta.add_version(fi)
+        self._write_xlmeta(dst_volume, dst_path, meta)
+        # Clean the tmp staging dir.
+        src_dir = self._file_path(src_volume, src_path)
+        shutil.rmtree(src_dir, ignore_errors=True)
+
+    def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
+        try:
+            meta = self._read_xlmeta(volume, path)
+        except serr.FileNotFound:
+            meta = XLMeta()
+        meta.add_version(fi)
+        self._write_xlmeta(volume, path, meta)
+
+    def read_version(self, volume: str, path: str,
+                     version_id: str = "") -> FileInfo:
+        meta = self._read_xlmeta(volume, path)
+        v = meta.find_version(version_id)
+        if v is None:
+            if version_id:
+                raise serr.VersionNotFound(f"{path}@{version_id}")
+            raise serr.FileNotFound(path)
+        return FileInfo.from_version_dict(volume, path, v)
+
+    def delete_version(self, volume: str, path: str, fi: FileInfo) -> None:
+        meta = self._read_xlmeta(volume, path)
+        v = meta.delete_version(fi.version_id)
+        if v is None:
+            raise serr.VersionNotFound(f"{path}@{fi.version_id}")
+        obj_dir = self._file_path(volume, path)
+        dd = v.get("dataDir")
+        if dd and not any(x.get("dataDir") == dd for x in meta.versions):
+            shutil.rmtree(os.path.join(obj_dir, dd), ignore_errors=True)
+        if meta.versions:
+            self._write_xlmeta(volume, path, meta)
+        else:
+            self.delete(volume, path, recursive=True)
+
+    def read_parts(self, volume: str, path: str, data_dir: str,
+                   ) -> list[str]:
+        full = self._file_path(volume, os.path.join(path, data_dir))
+        try:
+            return sorted(n for n in os.listdir(full)
+                          if n.startswith("part."))
+        except FileNotFoundError:
+            raise serr.FileNotFound(f"{volume}/{path}/{data_dir}")
+
+    def verify_file(self, volume: str, path: str, fi: FileInfo) -> None:
+        """Deep bitrot scan of every part shard on this disk
+        (ref cmd/xl-storage.go:2312,2380)."""
+        shard_size = fi.erasure.shard_size()
+        for part in fi.parts:
+            rel = os.path.join(path, fi.data_dir, f"part.{part.number}")
+            stream = self.read_all(volume, rel)
+            algo = bitrot.DEFAULT_ALGORITHM
+            for cs in fi.erasure.checksums:
+                if cs.get("part") == part.number:
+                    algo = cs.get("algorithm", algo)
+            if bitrot.is_streaming(algo):
+                if not bitrot.verify_stream(stream, shard_size, algo):
+                    raise serr.FileCorrupt(f"{path} part {part.number}")
+            else:
+                want = ""
+                for cs in fi.erasure.checksums:
+                    if cs.get("part") == part.number:
+                        want = cs.get("hash", "")
+                if want and bitrot.digest(algo, stream).hex() != want:
+                    raise serr.FileCorrupt(f"{path} part {part.number}")
