@@ -1,0 +1,76 @@
+"""CLI entry: `python -m minio_tpu server /data/disk{1...4}`
+(ref main.go:36, cmd/server-main.go:388 serverMain)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="minio-tpu",
+        description="TPU-native S3-compatible erasure-coded object store")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    srv = sub.add_parser("server", help="start the object-store server")
+    srv.add_argument("disks", nargs="+",
+                     help="disk paths; ellipses supported: /data/d{1...4}")
+    srv.add_argument("--address", default="0.0.0.0:9000",
+                     help="listen address (host:port)")
+    srv.add_argument("--block-size", type=int, default=None,
+                     help="erasure stripe block size in bytes")
+    args = parser.parse_args(argv)
+
+    if args.command == "server":
+        return _serve(args)
+    return 2
+
+
+def _serve(args) -> int:
+    from .erasure.engine import ErasureObjects
+    from .s3.server import S3Server
+    from .storage.xl import XLStorage
+    from .utils.ellipses import expand_all
+
+    disk_paths = expand_all(args.disks)
+    if len(disk_paths) < 2:
+        print("error: need at least 2 disks for erasure coding",
+              file=sys.stderr)
+        return 1
+    for p in disk_paths:
+        os.makedirs(p, exist_ok=True)
+    disks = [XLStorage(p) for p in disk_paths]
+
+    kwargs = {}
+    if args.block_size:
+        kwargs["block_size"] = args.block_size
+    layer = ErasureObjects(disks, **kwargs)
+
+    host, _, port_s = args.address.rpartition(":")
+    host = host or "0.0.0.0"
+    access = os.environ.get("MINIO_ACCESS_KEY", "minioadmin")
+    secret = os.environ.get("MINIO_SECRET_KEY", "minioadmin")
+    server = S3Server(layer, access, secret)
+    port = server.start(host, int(port_s))
+
+    print(f"minio-tpu server: {len(disks)} disks, "
+          f"EC {layer.k}+{layer.m}, listening on {host}:{port}")
+    print(f"   access key: {access}")
+    sys.stdout.flush()
+
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            signal.pause()
+    except KeyboardInterrupt:
+        pass
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

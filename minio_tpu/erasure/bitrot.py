@@ -95,6 +95,24 @@ class BitrotMismatch(Exception):
     cmd/bitrot-streaming.go:30)."""
 
 
+def extract_block(buf: bytes, block_idx: int, chunk: int, shard_size: int,
+                  algo: str = DEFAULT_ALGORITHM) -> bytes:
+    """Extract + verify one [hash][block] frame from a streaming shard
+    buffer whose frame 0 starts at byte 0 (a whole file or a ranged
+    window). `chunk` is the expected block payload length."""
+    if not is_streaming(algo):
+        return buf[block_idx * shard_size:block_idx * shard_size + chunk]
+    hsz = hash_size(algo)
+    base = block_idx * (hsz + shard_size)
+    want = buf[base:base + hsz]
+    data = buf[base + hsz:base + hsz + chunk]
+    if len(want) < hsz or len(data) < chunk:
+        raise BitrotMismatch("truncated shard stream")
+    if digest(algo, data) != want:
+        raise BitrotMismatch(f"content hash mismatch at block {block_idx}")
+    return data
+
+
 def decode_stream_at(stream: bytes, offset: int, length: int,
                      shard_size: int, algo: str = DEFAULT_ALGORITHM,
                      ) -> bytes:
@@ -113,14 +131,12 @@ def decode_stream_at(stream: bytes, offset: int, length: int,
     block_idx = offset // shard_size
     remaining = length
     while remaining > 0:
-        stream_off = block_idx * (hsz + shard_size)
-        want_hash = stream[stream_off:stream_off + hsz]
-        block = stream[stream_off + hsz:stream_off + hsz + shard_size]
-        if len(want_hash) < hsz or len(block) == 0:
+        base = block_idx * (hsz + shard_size)
+        avail = len(stream) - base - hsz
+        if avail <= 0:
             raise BitrotMismatch("truncated shard stream")
-        if digest(algo, block) != want_hash:
-            raise BitrotMismatch(
-                f"content hash mismatch at block {block_idx}")
+        chunk = min(shard_size, avail)
+        block = extract_block(stream, block_idx, chunk, shard_size, algo)
         take = min(remaining, len(block))
         out += block[:take]
         remaining -= take

@@ -37,6 +37,14 @@ from .codec import BLOCK_SIZE, Erasure
 
 TMP_PATH = "tmp"
 
+_UUID_RE = __import__("re").compile(
+    r"^[0-9a-f]{8}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{12}$")
+
+
+def _looks_like_data_dir(name: str) -> bool:
+    """Data dirs are uuid4 names (metadata.new_data_dir)."""
+    return bool(_UUID_RE.match(name))
+
 
 class ObjectNotFound(Exception):
     pass
@@ -99,15 +107,18 @@ class ErasureObjects:
     # buckets
 
     def make_bucket(self, bucket: str) -> None:
+        self._check_not_reserved(bucket)
         _, errs = parallel_map(
             [lambda d=d: d.make_volume(bucket) for d in self.disks])
-        if any(isinstance(e, serr.VolumeExists) for e in errs):
-            # Exists on some disk: treat as exists (heal converges later).
-            if all(e is None or isinstance(e, serr.VolumeExists)
-                   for e in errs):
-                raise BucketExists(bucket)
+        exists = [isinstance(e, serr.VolumeExists) for e in errs]
+        if any(exists) and not any(e is None for e in errs):
+            # No disk actually created it -> it already exists (faulty
+            # disks tolerated; heal converges stragglers later).
+            raise BucketExists(bucket)
+        # A disk where the volume already exists counts as success.
+        eff = [None if ex else e for e, ex in zip(errs, exists)]
         try:
-            reduce_quorum_errs(errs, len(self.disks) // 2 + 1, "make_bucket")
+            reduce_quorum_errs(eff, len(self.disks) // 2 + 1, "make_bucket")
         except QuorumError:
             # Roll back partial creates.
             parallel_map([lambda d=d: d.delete_volume(bucket, force=True)
@@ -115,6 +126,7 @@ class ErasureObjects:
             raise
 
     def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        self._check_not_reserved(bucket)
         _, errs = parallel_map(
             [lambda d=d: d.delete_volume(bucket, force=force)
              for d in self.disks])
@@ -144,9 +156,16 @@ class ErasureObjects:
                         if isinstance(e, serr.VolumeNotFound))
         return ok >= 1 and not_found <= len(self.disks) // 2
 
+    @staticmethod
+    def _check_not_reserved(bucket: str) -> None:
+        """The system namespace is never reachable through the object API
+        (ref isReservedOrInvalidBucket checks on every handler)."""
+        if bucket == MINIO_META_BUCKET or bucket.startswith(
+                MINIO_META_BUCKET + "/"):
+            raise BucketNotFound(bucket)
+
     def _check_bucket(self, bucket: str) -> None:
-        if bucket == MINIO_META_BUCKET:
-            return
+        self._check_not_reserved(bucket)
         if not self.bucket_exists(bucket):
             raise BucketNotFound(bucket)
 
@@ -179,27 +198,36 @@ class ErasureObjects:
             disk = self.disks[i]
             shard_idx = distribution[i] - 1
             tmp_path = f"{TMP_PATH}/{tmp_id}"
-            if len(data) > 0:
-                disk.create_file(MINIO_META_BUCKET,
-                                 f"{tmp_path}/{data_dir}/part.1",
-                                 shard_streams[shard_idx])
-            fi = FileInfo(
-                volume=bucket, name=object_name, version_id=version_id,
-                data_dir=data_dir if len(data) > 0 else "",
-                size=len(data), mod_time=mod_time, metadata=meta,
-                parts=[part],
-                erasure=ErasureInfo(
-                    data_blocks=self.k, parity_blocks=self.m,
-                    block_size=self.block_size, index=distribution[i],
-                    distribution=list(distribution),
-                    checksums=[{"part": 1,
-                                "algorithm": bitrot.DEFAULT_ALGORITHM,
-                                "hash": ""}],
-                ),
-            )
-            disk.rename_data(MINIO_META_BUCKET, tmp_path, fi,
-                             bucket, object_name)
-            return fi
+            try:
+                if len(data) > 0:
+                    disk.create_file(MINIO_META_BUCKET,
+                                     f"{tmp_path}/{data_dir}/part.1",
+                                     shard_streams[shard_idx])
+                fi = FileInfo(
+                    volume=bucket, name=object_name, version_id=version_id,
+                    data_dir=data_dir if len(data) > 0 else "",
+                    size=len(data), mod_time=mod_time, metadata=meta,
+                    parts=[part],
+                    erasure=ErasureInfo(
+                        data_blocks=self.k, parity_blocks=self.m,
+                        block_size=self.block_size, index=distribution[i],
+                        distribution=list(distribution),
+                        checksums=[{"part": 1,
+                                    "algorithm": bitrot.DEFAULT_ALGORITHM,
+                                    "hash": ""}],
+                    ),
+                )
+                disk.rename_data(MINIO_META_BUCKET, tmp_path, fi,
+                                 bucket, object_name)
+                return fi
+            except BaseException:
+                # Don't leak staged shards on failed disks (the reference
+                # deletes the tmp prefix on every error path).
+                try:
+                    disk.delete(MINIO_META_BUCKET, tmp_path, recursive=True)
+                except Exception:
+                    pass
+                raise
 
         _, errs = parallel_map(
             [lambda i=i: write_one(i) for i in range(n)])
@@ -336,12 +364,19 @@ class ErasureObjects:
         end_block = (offset + length - 1) // fi.erasure.block_size
         n_cov = end_block - start_block + 1
 
+        # Bitrot algorithm comes from the object's own metadata, not the
+        # current default — framing stride depends on it.
+        algo = bitrot.DEFAULT_ALGORITHM
+        for cs in fi.erasure.checksums:
+            if cs.get("part") == 1:
+                algo = cs.get("algorithm", algo)
+
         # Ranged shard-file window: each full block contributes
         # [hash][shard_size] to the stream, so blocks [b0, b1] live at
         # byte offset b0*stride, length <= n_cov*stride (short at EOF for
         # the last block; ref streamingBitrotReader stream offset math,
         # cmd/bitrot-streaming.go:125).
-        hsz = bitrot.hash_size(bitrot.DEFAULT_ALGORITHM)
+        hsz = bitrot.hash_size(algo)
         stride = hsz + shard_size
         win_off = start_block * stride
 
@@ -383,16 +418,8 @@ class ErasureObjects:
         def block_chunk(j: int, local: int, chunk: int) -> bytes:
             """Extract + bitrot-verify one block's chunk from shard j's
             window; raises BitrotMismatch."""
-            base = local * stride
-            win = windows[j]
-            want = win[base:base + hsz]
-            data = win[base + hsz:base + hsz + chunk]
-            if len(want) < hsz or len(data) < chunk:
-                raise bitrot.BitrotMismatch("truncated shard stream")
-            if bitrot.digest(bitrot.DEFAULT_ALGORITHM, data) != want:
-                raise bitrot.BitrotMismatch(
-                    f"content hash mismatch (shard {j})")
-            return data
+            return bitrot.extract_block(windows[j], local, chunk,
+                                        shard_size, algo)
 
         out = bytearray()
         for b in range(start_block, end_block + 1):
@@ -463,12 +490,18 @@ class ErasureObjects:
                 entries = disk.list_dir(bucket, path)
             except serr.StorageError:
                 return
-            if "xl.meta" in entries:
+            is_object = "xl.meta" in entries
+            if is_object:
                 names.add(path)
-                return
             for e in entries:
-                if e.endswith("/"):
-                    walk(disk, f"{path}{e}" if path else e)
+                if not e.endswith("/"):
+                    continue
+                # Skip an object's data dirs (uuid dirs holding part files)
+                # but keep descending into real sub-prefixes: an object
+                # 'a' must not hide objects under 'a/'.
+                if is_object and _looks_like_data_dir(e.rstrip("/")):
+                    continue
+                walk(disk, f"{path}{e}" if path else e)
 
         # Union across every disk so objects thin on some disks (partial
         # writes within quorum) still list.

@@ -1,0 +1,3 @@
+"""S3-compatible API surface: SigV4 auth, routers, handlers, XML wire
+format, error codes (ref cmd/api-router.go, cmd/object-handlers.go,
+cmd/signature-v4.go)."""

@@ -1,0 +1,112 @@
+"""S3 API error codes and XML error responses (ref cmd/api-errors.go —
+the reference carries ~400 codes; this registry holds the actively-used
+subset and grows with the handlers)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class APIError(Exception):
+    code: str
+    description: str
+    http_status: int
+
+    def xml(self, resource: str = "", request_id: str = "") -> bytes:
+        from .xmlutil import Element
+        e = Element("Error")
+        e.child("Code", self.code)
+        e.child("Message", self.description)
+        e.child("Resource", resource)
+        e.child("RequestId", request_id)
+        return e.tobytes()
+
+
+def _e(code: str, desc: str, status: int) -> APIError:
+    return APIError(code, desc, status)
+
+
+ERR_ACCESS_DENIED = _e("AccessDenied", "Access Denied.", 403)
+ERR_BAD_DIGEST = _e("BadDigest",
+                    "The Content-Md5 you specified did not match what we "
+                    "received.", 400)
+ERR_BUCKET_ALREADY_EXISTS = _e(
+    "BucketAlreadyOwnedByYou",
+    "Your previous request to create the named bucket succeeded and you "
+    "already own it.", 409)
+ERR_BUCKET_NOT_EMPTY = _e("BucketNotEmpty",
+                          "The bucket you tried to delete is not empty.",
+                          409)
+ERR_NO_SUCH_BUCKET = _e("NoSuchBucket",
+                        "The specified bucket does not exist.", 404)
+ERR_NO_SUCH_KEY = _e("NoSuchKey", "The specified key does not exist.", 404)
+ERR_NO_SUCH_VERSION = _e(
+    "NoSuchVersion",
+    "Indicates that the version ID specified in the request does not "
+    "match an existing version.", 404)
+ERR_NO_SUCH_UPLOAD = _e(
+    "NoSuchUpload",
+    "The specified multipart upload does not exist.", 404)
+ERR_INVALID_BUCKET_NAME = _e("InvalidBucketName",
+                             "The specified bucket is not valid.", 400)
+ERR_INVALID_ARGUMENT = _e("InvalidArgument", "Invalid Argument", 400)
+ERR_INVALID_RANGE = _e("InvalidRange",
+                       "The requested range is not satisfiable", 416)
+ERR_INVALID_PART = _e(
+    "InvalidPart",
+    "One or more of the specified parts could not be found.", 400)
+ERR_INVALID_PART_ORDER = _e(
+    "InvalidPartOrder",
+    "The list of parts was not in ascending order.", 400)
+ERR_ENTITY_TOO_SMALL = _e(
+    "EntityTooSmall",
+    "Your proposed upload is smaller than the minimum allowed object "
+    "size.", 400)
+ERR_ENTITY_TOO_LARGE = _e(
+    "EntityTooLarge",
+    "Your proposed upload exceeds the maximum allowed object size.", 400)
+ERR_METHOD_NOT_ALLOWED = _e(
+    "MethodNotAllowed",
+    "The specified method is not allowed against this resource.", 405)
+ERR_MALFORMED_XML = _e(
+    "MalformedXML",
+    "The XML you provided was not well-formed or did not validate "
+    "against our published schema.", 400)
+ERR_MISSING_CONTENT_LENGTH = _e("MissingContentLength",
+                                "You must provide the Content-Length HTTP "
+                                "header.", 411)
+ERR_INTERNAL_ERROR = _e(
+    "InternalError",
+    "We encountered an internal error, please try again.", 500)
+ERR_SLOW_DOWN = _e("SlowDown", "Please reduce your request rate", 503)
+ERR_NOT_IMPLEMENTED = _e("NotImplemented",
+                         "A header you provided implies functionality "
+                         "that is not implemented", 501)
+ERR_SIGNATURE_DOES_NOT_MATCH = _e(
+    "SignatureDoesNotMatch",
+    "The request signature we calculated does not match the signature "
+    "you provided. Check your key and signing method.", 403)
+ERR_INVALID_ACCESS_KEY_ID = _e(
+    "InvalidAccessKeyId",
+    "The Access Key Id you provided does not exist in our records.", 403)
+ERR_MISSING_AUTH = _e(
+    "AccessDenied", "Request is missing authentication credentials.", 403)
+ERR_REQUEST_TIME_TOO_SKEWED = _e(
+    "RequestTimeTooSkewed",
+    "The difference between the request time and the server's time is "
+    "too large.", 403)
+ERR_AUTHORIZATION_HEADER_MALFORMED = _e(
+    "AuthorizationHeaderMalformed",
+    "The authorization header is malformed.", 400)
+ERR_EXPIRED_PRESIGN = _e("AccessDenied", "Request has expired", 403)
+ERR_PRECONDITION_FAILED = _e(
+    "PreconditionFailed",
+    "At least one of the pre-conditions you specified did not hold", 412)
+ERR_NO_SUCH_BUCKET_POLICY = _e(
+    "NoSuchBucketPolicy", "The bucket policy does not exist", 404)
+ERR_NO_SUCH_TAG_SET = _e("NoSuchTagSet",
+                         "The TagSet does not exist", 404)
+ERR_NO_SUCH_LIFECYCLE = _e(
+    "NoSuchLifecycleConfiguration",
+    "The lifecycle configuration does not exist", 404)

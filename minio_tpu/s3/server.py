@@ -1,0 +1,486 @@
+"""S3-compatible HTTP server: router + object/bucket handlers.
+
+The analog of the reference's L1/L2 (ref cmd/routers.go:86 middleware
+chain, cmd/api-router.go:82 route table, cmd/object-handlers.go,
+cmd/bucket-handlers.go), over Python stdlib http.server (threaded) with
+the erasure object engine as the ObjectLayer.
+"""
+
+from __future__ import annotations
+
+import base64
+import email.utils
+import hashlib
+import threading
+import time
+import urllib.parse
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..erasure.engine import (BucketExists, BucketNotFound, ErasureObjects,
+                              ObjectInfo, ObjectNotFound)
+from ..parallel.quorum import QuorumError
+from . import errors as s3err
+from . import sigv4
+from .errors import APIError
+from .xmlutil import S3_XMLNS, Element, parse
+
+MAX_OBJECT_SIZE = 5 * 1024 * 1024 * 1024  # single-PUT cap (5 GiB)
+
+
+def _iso8601(t: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(t))
+
+
+def _http_date(t: float) -> str:
+    return email.utils.formatdate(t, usegmt=True)
+
+
+def _parse_range(header: str, size: int) -> tuple[int, int] | None:
+    """Parse 'bytes=a-b' -> (offset, length); None = whole object.
+    Raises InvalidRange when unsatisfiable (ref cmd/httprange.go)."""
+    if not header:
+        return None
+    if not header.startswith("bytes="):
+        return None
+    spec = header[len("bytes="):]
+    if "," in spec:  # multiple ranges unsupported, serve whole object
+        return None
+    start_s, _, end_s = spec.partition("-")
+    try:
+        if start_s == "":
+            n = int(end_s)  # suffix: last n bytes
+            if n <= 0:
+                raise s3err.ERR_INVALID_RANGE
+            n = min(n, size)
+            return size - n, n
+        start = int(start_s)
+        if end_s == "":
+            if start >= size:
+                raise s3err.ERR_INVALID_RANGE
+            return start, size - start
+        end = int(end_s)
+        if start > end or start >= size:
+            raise s3err.ERR_INVALID_RANGE
+        return start, min(end, size - 1) - start + 1
+    except ValueError:
+        return None
+
+
+class S3Request:
+    """Parsed request context."""
+
+    def __init__(self, method: str, raw_path: str, query: str,
+                 headers: dict[str, str], body: bytes):
+        self.method = method
+        self.raw_path = raw_path
+        self.query = query
+        self.headers = headers  # lowercase keys
+        self.body = body
+        self.params = dict(urllib.parse.parse_qsl(
+            query, keep_blank_values=True))
+        path = urllib.parse.unquote(raw_path)
+        parts = path.lstrip("/").split("/", 1)
+        self.bucket = parts[0] if parts[0] else ""
+        self.key = parts[1] if len(parts) > 1 else ""
+        self.request_id = uuid.uuid4().hex[:16].upper()
+
+
+class S3Response:
+    def __init__(self, status: int = 200, body: bytes = b"",
+                 headers: dict[str, str] | None = None):
+        self.status = status
+        self.body = body
+        self.headers = headers or {}
+
+
+class S3ApiHandlers:
+    """S3 operations over an ObjectLayer (duck-typed ErasureObjects)."""
+
+    def __init__(self, layer: ErasureObjects, region: str = "us-east-1"):
+        self.layer = layer
+        self.region = region
+
+    # ---------------- service ----------------
+
+    def list_buckets(self, req: S3Request) -> S3Response:
+        root = Element("ListAllMyBucketsResult", S3_XMLNS)
+        owner = root.child("Owner")
+        owner.child("ID", "minio-tpu")
+        owner.child("DisplayName", "minio-tpu")
+        buckets = root.child("Buckets")
+        for b in self.layer.list_buckets():
+            e = buckets.child("Bucket")
+            e.child("Name", b["name"])
+            e.child("CreationDate", _iso8601(b["created"]))
+        return S3Response(200, root.tobytes(),
+                          {"Content-Type": "application/xml"})
+
+    # ---------------- bucket ----------------
+
+    def make_bucket(self, req: S3Request) -> S3Response:
+        if not (3 <= len(req.bucket) <= 63) or not all(
+                c.islower() or c.isdigit() or c in ".-"
+                for c in req.bucket):
+            raise s3err.ERR_INVALID_BUCKET_NAME
+        try:
+            self.layer.make_bucket(req.bucket)
+        except BucketExists:
+            raise s3err.ERR_BUCKET_ALREADY_EXISTS
+        return S3Response(200, headers={"Location": f"/{req.bucket}"})
+
+    def head_bucket(self, req: S3Request) -> S3Response:
+        if not self.layer.bucket_exists(req.bucket):
+            raise s3err.ERR_NO_SUCH_BUCKET
+        return S3Response(200)
+
+    def delete_bucket(self, req: S3Request) -> S3Response:
+        try:
+            self.layer.delete_bucket(req.bucket)
+        except BucketNotFound:
+            raise s3err.ERR_NO_SUCH_BUCKET
+        except BucketExists:
+            raise s3err.ERR_BUCKET_NOT_EMPTY
+        return S3Response(204)
+
+    def get_location(self, req: S3Request) -> S3Response:
+        # us-east-1 renders as an empty LocationConstraint.
+        body = (b'<?xml version="1.0" encoding="UTF-8"?>'
+                b'<LocationConstraint xmlns="' + S3_XMLNS.encode() +
+                b'"></LocationConstraint>')
+        return S3Response(200, body,
+                          {"Content-Type": "application/xml"})
+
+    def list_objects(self, req: S3Request) -> S3Response:
+        if not self.layer.bucket_exists(req.bucket):
+            raise s3err.ERR_NO_SUCH_BUCKET
+        v2 = req.params.get("list-type") == "2"
+        prefix = req.params.get("prefix", "")
+        delimiter = req.params.get("delimiter", "")
+        max_keys = min(int(req.params.get("max-keys", "1000") or "1000"),
+                       1000)
+        marker = (req.params.get("continuation-token")
+                  or req.params.get("start-after")
+                  or req.params.get("marker", ""))
+        if req.params.get("continuation-token"):
+            marker = base64.b64decode(marker).decode()
+
+        infos = self.layer.list_objects(req.bucket, prefix=prefix,
+                                        max_keys=1_000_000)
+        contents: list[ObjectInfo] = []
+        common: list[str] = []
+        seen_prefix: set[str] = set()
+        truncated = False
+        next_marker = ""
+        for info in infos:
+            name = info.name
+            if marker and name <= marker:
+                continue
+            if delimiter:
+                rest = name[len(prefix):]
+                if delimiter in rest:
+                    cp = prefix + rest.split(delimiter)[0] + delimiter
+                    if cp not in seen_prefix:
+                        if len(contents) + len(seen_prefix) >= max_keys:
+                            truncated = True
+                            break
+                        seen_prefix.add(cp)
+                        common.append(cp)
+                        next_marker = cp.rstrip(delimiter)
+                    continue
+            if len(contents) + len(seen_prefix) >= max_keys:
+                truncated = True
+                break
+            contents.append(info)
+            next_marker = name
+
+        root = Element("ListBucketResult", S3_XMLNS)
+        root.child("Name", req.bucket)
+        root.child("Prefix", prefix)
+        root.child("MaxKeys", max_keys)
+        root.child("Delimiter", delimiter)
+        root.child("IsTruncated", truncated)
+        if v2:
+            root.child("KeyCount", len(contents) + len(common))
+            if truncated and next_marker:
+                root.child("NextContinuationToken",
+                           base64.b64encode(
+                               next_marker.encode()).decode())
+        elif truncated and next_marker:
+            root.child("NextMarker", next_marker)
+        for info in contents:
+            c = root.child("Contents")
+            c.child("Key", info.name)
+            c.child("LastModified", _iso8601(info.mod_time))
+            c.child("ETag", f'"{info.etag}"')
+            c.child("Size", info.size)
+            c.child("StorageClass", "STANDARD")
+        for cp in common:
+            p = root.child("CommonPrefixes")
+            p.child("Prefix", cp)
+        return S3Response(200, root.tobytes(),
+                          {"Content-Type": "application/xml"})
+
+    def delete_multiple(self, req: S3Request) -> S3Response:
+        try:
+            doc = parse(req.body)
+        except Exception:
+            raise s3err.ERR_MALFORMED_XML
+        quiet = doc.findtext("Quiet") == "true"
+        root = Element("DeleteResult", S3_XMLNS)
+        for obj in doc.findall("Object"):
+            key = obj.findtext("Key") or ""
+            try:
+                self.layer.delete_object(req.bucket, key)
+                if not quiet:
+                    d = root.child("Deleted")
+                    d.child("Key", key)
+            except ObjectNotFound:
+                if not quiet:  # S3 treats missing keys as deleted
+                    d = root.child("Deleted")
+                    d.child("Key", key)
+            except Exception:
+                e = root.child("Error")
+                e.child("Key", key)
+                e.child("Code", "InternalError")
+        return S3Response(200, root.tobytes(),
+                          {"Content-Type": "application/xml"})
+
+    # ---------------- object ----------------
+
+    @staticmethod
+    def _object_headers(info: ObjectInfo) -> dict[str, str]:
+        h = {
+            "ETag": f'"{info.etag}"',
+            "Last-Modified": _http_date(info.mod_time),
+            "Accept-Ranges": "bytes",
+            "Content-Type": info.metadata.get(
+                "content-type", "application/octet-stream"),
+        }
+        if info.version_id:
+            h["x-amz-version-id"] = info.version_id
+        for k, v in info.metadata.items():
+            if k.startswith("x-amz-meta-"):
+                h[k] = v
+        return h
+
+    def put_object(self, req: S3Request) -> S3Response:
+        if "x-amz-copy-source" in req.headers:
+            return self.copy_object(req)
+        if len(req.body) > MAX_OBJECT_SIZE:
+            raise s3err.ERR_ENTITY_TOO_LARGE
+        md5_header = req.headers.get("content-md5", "")
+        if md5_header:
+            want = base64.b64decode(md5_header)
+            if hashlib.md5(req.body).digest() != want:
+                raise s3err.ERR_BAD_DIGEST
+        meta = {"content-type": req.headers.get(
+            "content-type", "application/octet-stream")}
+        for k, v in req.headers.items():
+            if k.startswith("x-amz-meta-"):
+                meta[k] = v
+        try:
+            info = self.layer.put_object(req.bucket, req.key, req.body,
+                                         metadata=meta)
+        except BucketNotFound:
+            raise s3err.ERR_NO_SUCH_BUCKET
+        h = {"ETag": f'"{info.etag}"'}
+        if info.version_id:
+            h["x-amz-version-id"] = info.version_id
+        return S3Response(200, headers=h)
+
+    def copy_object(self, req: S3Request) -> S3Response:
+        src = urllib.parse.unquote(req.headers["x-amz-copy-source"])
+        src = src.lstrip("/")
+        if "/" not in src:
+            raise s3err.ERR_INVALID_ARGUMENT
+        sbucket, skey = src.split("/", 1)
+        try:
+            data, sinfo = self.layer.get_object(sbucket, skey)
+        except (ObjectNotFound, BucketNotFound):
+            raise s3err.ERR_NO_SUCH_KEY
+        meta = dict(sinfo.metadata)
+        if req.headers.get("x-amz-metadata-directive") == "REPLACE":
+            meta = {"content-type": req.headers.get(
+                "content-type", "application/octet-stream")}
+            for k, v in req.headers.items():
+                if k.startswith("x-amz-meta-"):
+                    meta[k] = v
+        meta.pop("etag", None)
+        info = self.layer.put_object(req.bucket, req.key, data,
+                                     metadata=meta)
+        root = Element("CopyObjectResult", S3_XMLNS)
+        root.child("ETag", f'"{info.etag}"')
+        root.child("LastModified", _iso8601(info.mod_time))
+        return S3Response(200, root.tobytes(),
+                          {"Content-Type": "application/xml"})
+
+    def get_object(self, req: S3Request, head: bool = False) -> S3Response:
+        version_id = req.params.get("versionId", "")
+        try:
+            if head:
+                info = self.layer.get_object_info(req.bucket, req.key,
+                                                  version_id)
+                data = b""
+            else:
+                info = self.layer.get_object_info(req.bucket, req.key,
+                                                  version_id)
+                rng = _parse_range(req.headers.get("range", ""), info.size)
+                if rng is None:
+                    data, info = self.layer.get_object(
+                        req.bucket, req.key, version_id=version_id)
+                else:
+                    off, ln = rng
+                    data, info = self.layer.get_object(
+                        req.bucket, req.key, offset=off, length=ln,
+                        version_id=version_id)
+        except BucketNotFound:
+            raise s3err.ERR_NO_SUCH_BUCKET
+        except ObjectNotFound:
+            if version_id:
+                raise s3err.ERR_NO_SUCH_VERSION
+            raise s3err.ERR_NO_SUCH_KEY
+
+        headers = self._object_headers(info)
+        if head:
+            headers["Content-Length"] = str(info.size)
+            return S3Response(200, b"", headers)
+        rng = _parse_range(req.headers.get("range", ""), info.size)
+        if rng is not None:
+            off, ln = rng
+            headers["Content-Range"] = (
+                f"bytes {off}-{off + ln - 1}/{info.size}")
+            return S3Response(206, data, headers)
+        return S3Response(200, data, headers)
+
+    def delete_object(self, req: S3Request) -> S3Response:
+        version_id = req.params.get("versionId", "")
+        try:
+            self.layer.delete_object(req.bucket, req.key, version_id)
+        except (ObjectNotFound, BucketNotFound):
+            pass  # S3 DELETE is idempotent-success on missing keys
+        h = {}
+        if version_id:
+            h["x-amz-version-id"] = version_id
+        return S3Response(204, headers=h)
+
+
+class S3Server:
+    """HTTP front end with SigV4 auth (the reference's generic-handlers
+    auth dispatch, ref cmd/auth-handler.go)."""
+
+    def __init__(self, layer: ErasureObjects, access_key: str = "minioadmin",
+                 secret_key: str = "minioadmin", region: str = "us-east-1"):
+        self.handlers = S3ApiHandlers(layer, region)
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    def _lookup_secret(self, access_key: str) -> str | None:
+        return self.secret_key if access_key == self.access_key else None
+
+    def authenticate(self, req: S3Request) -> str:
+        if "authorization" in req.headers:
+            return sigv4.verify_header_auth(
+                req.method, req.raw_path, req.query, req.headers,
+                hashlib.sha256(req.body).hexdigest(), self._lookup_secret)
+        if "X-Amz-Signature" in req.params:
+            return sigv4.verify_presigned(
+                req.method, req.raw_path, req.query, req.headers,
+                self._lookup_secret)
+        raise s3err.ERR_MISSING_AUTH
+
+    def route(self, req: S3Request) -> S3Response:
+        h = self.handlers
+        self.authenticate(req)
+        m, bucket, key, p = req.method, req.bucket, req.key, req.params
+        if not bucket:
+            if m == "GET":
+                return h.list_buckets(req)
+            raise s3err.ERR_METHOD_NOT_ALLOWED
+        if not key:
+            if m == "PUT":
+                return h.make_bucket(req)
+            if m == "HEAD":
+                return h.head_bucket(req)
+            if m == "DELETE":
+                return h.delete_bucket(req)
+            if m == "POST" and "delete" in p:
+                return h.delete_multiple(req)
+            if m == "GET":
+                if "location" in p:
+                    return h.get_location(req)
+                return h.list_objects(req)
+            raise s3err.ERR_METHOD_NOT_ALLOWED
+        if m == "PUT":
+            return h.put_object(req)
+        if m == "GET":
+            return h.get_object(req)
+        if m == "HEAD":
+            return h.get_object(req, head=True)
+        if m == "DELETE":
+            return h.delete_object(req)
+        raise s3err.ERR_METHOD_NOT_ALLOWED
+
+    # ---------------- HTTP plumbing ----------------
+
+    def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):  # silence
+                pass
+
+            def _handle(self):
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(length) if length else b""
+                    raw_path, _, query = self.path.partition("?")
+                    headers = {k.lower(): v for k, v in self.headers.items()}
+                    req = S3Request(self.command, raw_path, query, headers,
+                                    body)
+                    try:
+                        resp = server.route(req)
+                    except APIError as e:
+                        resp = S3Response(
+                            e.http_status,
+                            e.xml(raw_path, req.request_id),
+                            {"Content-Type": "application/xml"})
+                    except (QuorumError, Exception) as e:  # noqa: BLE001
+                        if isinstance(e, APIError):
+                            raise
+                        err = s3err.ERR_INTERNAL_ERROR
+                        resp = S3Response(
+                            err.http_status,
+                            err.xml(raw_path, req.request_id),
+                            {"Content-Type": "application/xml"})
+                    self.send_response(resp.status)
+                    self.send_header("x-amz-request-id", req.request_id)
+                    self.send_header("Server", "MinIO-TPU")
+                    for k, v in resp.headers.items():
+                        self.send_header(k, v)
+                    if "Content-Length" not in resp.headers:
+                        self.send_header("Content-Length",
+                                         str(len(resp.body)))
+                    self.end_headers()
+                    if self.command != "HEAD" and resp.body:
+                        self.wfile.write(resp.body)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+
+            do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _handle
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self._httpd.server_address[1]
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()

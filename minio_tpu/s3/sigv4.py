@@ -1,0 +1,235 @@
+"""AWS Signature Version 4 — verification and client-side signing
+(ref cmd/signature-v4.go, cmd/signature-v4-parser.go).
+
+Covers header auth (Authorization: AWS4-HMAC-SHA256 ...) and presigned
+query auth (X-Amz-Signature=...). Streaming aws-chunked signatures (ref
+cmd/streaming-signature-v4.go) layer on top when the handlers need them.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import time
+import urllib.parse
+from dataclasses import dataclass
+
+from .errors import (ERR_AUTHORIZATION_HEADER_MALFORMED,
+                     ERR_EXPIRED_PRESIGN, ERR_INVALID_ACCESS_KEY_ID,
+                     ERR_MISSING_AUTH, ERR_REQUEST_TIME_TOO_SKEWED,
+                     ERR_SIGNATURE_DOES_NOT_MATCH, APIError)
+
+SIGN_V4_ALGORITHM = "AWS4-HMAC-SHA256"
+UNSIGNED_PAYLOAD = "UNSIGNED-PAYLOAD"
+STREAMING_PAYLOAD = "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"
+MAX_SKEW_SECONDS = 15 * 60
+
+
+def _uri_encode(s: str, encode_slash: bool = True) -> str:
+    safe = "-_.~" if encode_slash else "-_.~/"
+    return urllib.parse.quote(s, safe=safe)
+
+
+def canonical_query(query: str) -> str:
+    """Sorted, re-encoded query string; X-Amz-Signature excluded."""
+    pairs = urllib.parse.parse_qsl(query, keep_blank_values=True)
+    enc = sorted((_uri_encode(k), _uri_encode(v)) for k, v in pairs
+                 if k != "X-Amz-Signature")
+    return "&".join(f"{k}={v}" for k, v in enc)
+
+
+def _canonical_request(method: str, raw_path: str, query: str,
+                       headers: dict[str, str], signed_headers: list[str],
+                       payload_hash: str) -> str:
+    canon_headers = "".join(
+        f"{h}:{' '.join(headers.get(h, '').split())}\n"
+        for h in signed_headers)
+    return "\n".join([
+        method.upper(), raw_path, canonical_query(query), canon_headers,
+        ";".join(signed_headers), payload_hash,
+    ])
+
+
+def _signing_key(secret: str, date: str, region: str, service: str,
+                 ) -> bytes:
+    k = hmac.new(("AWS4" + secret).encode(), date.encode(),
+                 hashlib.sha256).digest()
+    for part in (region, service, "aws4_request"):
+        k = hmac.new(k, part.encode(), hashlib.sha256).digest()
+    return k
+
+
+def _string_to_sign(amz_date: str, scope: str, canonical: str) -> str:
+    return "\n".join([
+        SIGN_V4_ALGORITHM, amz_date, scope,
+        hashlib.sha256(canonical.encode()).hexdigest(),
+    ])
+
+
+@dataclass
+class Credential:
+    access_key: str
+    date: str
+    region: str
+    service: str
+
+    @property
+    def scope(self) -> str:
+        return f"{self.date}/{self.region}/{self.service}/aws4_request"
+
+
+def _parse_credential(cred: str) -> Credential:
+    parts = cred.split("/")
+    if len(parts) != 5 or parts[4] != "aws4_request":
+        raise ERR_AUTHORIZATION_HEADER_MALFORMED
+    return Credential(parts[0], parts[1], parts[2], parts[3])
+
+
+def _check_skew(amz_date: str, now: float) -> None:
+    try:
+        t = time.mktime(time.strptime(amz_date, "%Y%m%dT%H%M%SZ"))
+        t -= time.timezone
+    except ValueError:
+        raise ERR_AUTHORIZATION_HEADER_MALFORMED
+    if abs(now - t) > MAX_SKEW_SECONDS:
+        raise ERR_REQUEST_TIME_TOO_SKEWED
+
+
+def verify_header_auth(method: str, raw_path: str, query: str,
+                       headers: dict[str, str], body_sha256: str,
+                       lookup_secret, now: float | None = None) -> str:
+    """Verify an Authorization-header SigV4 request; returns the access
+    key. `headers` keys must be lowercase. `lookup_secret(access_key) ->
+    secret | None`. Raises APIError subtypes on failure."""
+    auth = headers.get("authorization", "")
+    if not auth.startswith(SIGN_V4_ALGORITHM):
+        raise ERR_MISSING_AUTH
+    fields = {}
+    for item in auth[len(SIGN_V4_ALGORITHM):].split(","):
+        item = item.strip()
+        if "=" not in item:
+            raise ERR_AUTHORIZATION_HEADER_MALFORMED
+        k, v = item.split("=", 1)
+        fields[k.strip()] = v.strip()
+    try:
+        cred = _parse_credential(fields["Credential"])
+        signed_headers = fields["SignedHeaders"].split(";")
+        signature = fields["Signature"]
+    except KeyError:
+        raise ERR_AUTHORIZATION_HEADER_MALFORMED
+
+    secret = lookup_secret(cred.access_key)
+    if secret is None:
+        raise ERR_INVALID_ACCESS_KEY_ID
+
+    amz_date = headers.get("x-amz-date", "")
+    if not amz_date:
+        raise ERR_MISSING_AUTH
+    _check_skew(amz_date, now if now is not None else time.time())
+
+    payload_hash = headers.get("x-amz-content-sha256", body_sha256)
+    canonical = _canonical_request(method, raw_path, query, headers,
+                                   signed_headers, payload_hash)
+    sts = _string_to_sign(amz_date, cred.scope, canonical)
+    want = hmac.new(
+        _signing_key(secret, cred.date, cred.region, cred.service),
+        sts.encode(), hashlib.sha256).hexdigest()
+    if not hmac.compare_digest(want, signature):
+        raise ERR_SIGNATURE_DOES_NOT_MATCH
+    return cred.access_key
+
+
+def verify_presigned(method: str, raw_path: str, query: str,
+                     headers: dict[str, str], lookup_secret,
+                     now: float | None = None) -> str:
+    """Verify a presigned-URL request; returns the access key."""
+    q = dict(urllib.parse.parse_qsl(query, keep_blank_values=True))
+    if q.get("X-Amz-Algorithm") != SIGN_V4_ALGORITHM:
+        raise ERR_MISSING_AUTH
+    try:
+        cred = _parse_credential(q["X-Amz-Credential"])
+        amz_date = q["X-Amz-Date"]
+        expires = int(q["X-Amz-Expires"])
+        signed_headers = q["X-Amz-SignedHeaders"].split(";")
+        signature = q["X-Amz-Signature"]
+    except (KeyError, ValueError):
+        raise ERR_AUTHORIZATION_HEADER_MALFORMED
+
+    secret = lookup_secret(cred.access_key)
+    if secret is None:
+        raise ERR_INVALID_ACCESS_KEY_ID
+
+    now_t = now if now is not None else time.time()
+    try:
+        t0 = time.mktime(time.strptime(amz_date, "%Y%m%dT%H%M%SZ"))
+        t0 -= time.timezone
+    except ValueError:
+        raise ERR_AUTHORIZATION_HEADER_MALFORMED
+    if now_t > t0 + expires:
+        raise ERR_EXPIRED_PRESIGN
+
+    canonical = _canonical_request(method, raw_path, query, headers,
+                                   signed_headers, UNSIGNED_PAYLOAD)
+    sts = _string_to_sign(amz_date, cred.scope, canonical)
+    want = hmac.new(
+        _signing_key(secret, cred.date, cred.region, cred.service),
+        sts.encode(), hashlib.sha256).hexdigest()
+    if not hmac.compare_digest(want, signature):
+        raise ERR_SIGNATURE_DOES_NOT_MATCH
+    return cred.access_key
+
+
+# --- client side (tests, internal RPC, presign generation) -------------------
+
+
+def sign_request(method: str, path: str, query: str, headers: dict[str, str],
+                 body: bytes, access_key: str, secret_key: str,
+                 region: str = "us-east-1",
+                 amz_time: float | None = None) -> dict[str, str]:
+    """Produce headers (lowercase keys) with SigV4 Authorization added.
+    `headers` must already include 'host'."""
+    t = time.gmtime(amz_time if amz_time is not None else time.time())
+    amz_date = time.strftime("%Y%m%dT%H%M%SZ", t)
+    date = time.strftime("%Y%m%d", t)
+    payload_hash = hashlib.sha256(body).hexdigest()
+    out = {k.lower(): v for k, v in headers.items()}
+    out["x-amz-date"] = amz_date
+    out["x-amz-content-sha256"] = payload_hash
+    signed = sorted(out)
+    cred = Credential(access_key, date, region, "s3")
+    canonical = _canonical_request(method, path, query, out, signed,
+                                   payload_hash)
+    sts = _string_to_sign(amz_date, cred.scope, canonical)
+    sig = hmac.new(_signing_key(secret_key, date, region, "s3"),
+                   sts.encode(), hashlib.sha256).hexdigest()
+    out["authorization"] = (
+        f"{SIGN_V4_ALGORITHM} Credential={cred.access_key}/{cred.scope}, "
+        f"SignedHeaders={';'.join(signed)}, Signature={sig}")
+    return out
+
+
+def presign_url(method: str, host: str, path: str, access_key: str,
+                secret_key: str, expires: int = 3600,
+                region: str = "us-east-1",
+                amz_time: float | None = None,
+                extra_query: dict[str, str] | None = None) -> str:
+    """Generate a presigned URL (ref web-handlers PresignedGet)."""
+    t = time.gmtime(amz_time if amz_time is not None else time.time())
+    amz_date = time.strftime("%Y%m%dT%H%M%SZ", t)
+    date = time.strftime("%Y%m%d", t)
+    cred = Credential(access_key, date, region, "s3")
+    q = {
+        "X-Amz-Algorithm": SIGN_V4_ALGORITHM,
+        "X-Amz-Credential": f"{access_key}/{cred.scope}",
+        "X-Amz-Date": amz_date,
+        "X-Amz-Expires": str(expires),
+        "X-Amz-SignedHeaders": "host",
+    }
+    q.update(extra_query or {})
+    query = urllib.parse.urlencode(q)
+    canonical = _canonical_request(method, path, query, {"host": host},
+                                   ["host"], UNSIGNED_PAYLOAD)
+    sts = _string_to_sign(amz_date, cred.scope, canonical)
+    sig = hmac.new(_signing_key(secret_key, date, region, "s3"),
+                   sts.encode(), hashlib.sha256).hexdigest()
+    return (f"http://{host}{path}?{query}&X-Amz-Signature={sig}")

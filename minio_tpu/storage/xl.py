@@ -103,6 +103,8 @@ class XLStorage(StorageAPI):
         return {"name": volume, "created": st.st_mtime}
 
     def delete_volume(self, volume: str, force: bool = False) -> None:
+        if volume in _RESERVED_VOLUMES:
+            raise serr.VolumeNotFound(f"{volume} is reserved")
         p = self._check_vol(volume)
         try:
             if force:
@@ -119,6 +121,7 @@ class XLStorage(StorageAPI):
     def _atomic_write(self, full: str, data: bytes) -> None:
         os.makedirs(os.path.dirname(full), exist_ok=True)
         tmp = os.path.join(self.root, TMP_DIR, str(uuid.uuid4()))
+        os.makedirs(os.path.dirname(tmp), exist_ok=True)
         try:
             with open(tmp, "wb") as f:
                 f.write(data)
@@ -257,19 +260,21 @@ class XLStorage(StorageAPI):
             meta = XLMeta()
         # Null-version overwrite frees the PREVIOUS NULL version's data dir
         # only (real versions keep theirs; ref xlMetaV2.AddVersion null-
-        # version replacement semantics).
+        # version replacement semantics). Crash safety: the new xl.meta is
+        # persisted BEFORE the orphaned data dir is removed, so metadata
+        # never points at deleted shards.
         old = None
         if fi.version_id == "":
             for v in meta.versions:
                 if v.get("versionId", "") == "":
                     old = v
                     break
+        meta.add_version(fi)
+        self._write_xlmeta(dst_volume, dst_path, meta)
         if old and old.get("dataDir") and old["dataDir"] != fi.data_dir:
             old_dd = os.path.join(dst_obj_dir, old["dataDir"])
             if os.path.isdir(old_dd):
                 shutil.rmtree(old_dd, ignore_errors=True)
-        meta.add_version(fi)
-        self._write_xlmeta(dst_volume, dst_path, meta)
         # Clean the tmp staging dir.
         src_dir = self._file_path(src_volume, src_path)
         shutil.rmtree(src_dir, ignore_errors=True)
@@ -298,11 +303,14 @@ class XLStorage(StorageAPI):
         if v is None:
             raise serr.VersionNotFound(f"{path}@{fi.version_id}")
         obj_dir = self._file_path(volume, path)
-        dd = v.get("dataDir")
-        if dd and not any(x.get("dataDir") == dd for x in meta.versions):
-            shutil.rmtree(os.path.join(obj_dir, dd), ignore_errors=True)
+        # Metadata first, data-dir removal second (crash-safe ordering).
         if meta.versions:
             self._write_xlmeta(volume, path, meta)
+            dd = v.get("dataDir")
+            if dd and not any(x.get("dataDir") == dd
+                              for x in meta.versions):
+                shutil.rmtree(os.path.join(obj_dir, dd),
+                              ignore_errors=True)
         else:
             self.delete(volume, path, recursive=True)
 

@@ -1,0 +1,34 @@
+"""Ellipses expansion tests (ref pkg/ellipses)."""
+
+import pytest
+
+from minio_tpu.utils.ellipses import expand, expand_all, has_ellipses
+
+
+def test_expand_simple():
+    assert expand("/data/d{1...4}") == [f"/data/d{i}" for i in (1, 2, 3, 4)]
+
+
+def test_expand_zero_padded():
+    assert expand("d{01...03}") == ["d01", "d02", "d03"]
+
+
+def test_expand_cartesian():
+    got = expand("http://h{1...2}/d{1...2}")
+    assert got == ["http://h1/d1", "http://h1/d2",
+                   "http://h2/d1", "http://h2/d2"]
+
+
+def test_no_ellipses_passthrough():
+    assert expand("/plain/path") == ["/plain/path"]
+    assert not has_ellipses("/plain/path")
+    assert has_ellipses("/d{1...2}")
+
+
+def test_invalid_range():
+    with pytest.raises(ValueError):
+        expand("d{5...2}")
+
+
+def test_expand_all():
+    assert expand_all(["a{1...2}", "b"]) == ["a1", "a2", "b"]

@@ -317,3 +317,48 @@ def test_ranged_read_is_windowed(tmp_path):
     assert got == payload[:100]
     # Each shard read must be one block window, far below full file size.
     assert reads and all(ln <= 3 * 8192 for _, ln in reads)
+
+
+def test_reserved_bucket_unreachable(tmp_path):
+    """The .minio.sys namespace is rejected on every object API."""
+    from minio_tpu.erasure.engine import BucketNotFound
+    e = make_engine(tmp_path, n=4)
+    for op in (lambda: e.make_bucket(".minio.sys"),
+               lambda: e.delete_bucket(".minio.sys", force=True),
+               lambda: e.put_object(".minio.sys", "tmp/x", b"junk"),
+               lambda: e.get_object(".minio.sys", "config"),
+               lambda: e.list_objects(".minio.sys")):
+        with pytest.raises(BucketNotFound):
+            op()
+
+
+def test_make_bucket_exists_with_one_faulty_disk(tmp_path):
+    """VolumeExists counts as success: a faulty disk must not turn an
+    exists-everywhere bucket into a quorum error."""
+    e = make_engine(tmp_path, n=4, naughty=True)
+    e.make_bucket("b")
+    e.disks[3].fail_methods = {"make_volume"}
+    with pytest.raises(BucketExists):
+        e.make_bucket("b")
+
+
+def test_failed_put_leaves_no_tmp_garbage(tmp_path):
+    """Staged shards are cleaned up on disks where the write failed."""
+    e = make_engine(tmp_path, n=4, naughty=True)
+    e.make_bucket("b")
+    e.disks[2].fail_methods = {"rename_data"}
+    e.put_object("b", "obj", os.urandom(5000))
+    tmp_dir = os.path.join(e.disks[2].inner.root, ".minio.sys", "tmp")
+    assert not os.path.isdir(tmp_dir) or os.listdir(tmp_dir) == []
+
+
+def test_object_does_not_shadow_prefix(tmp_path):
+    """An object 'a' and objects under 'a/' coexist and both list."""
+    e = make_engine(tmp_path, n=4)
+    e.make_bucket("b")
+    e.put_object("b", "a", b"object-a")
+    e.put_object("b", "a/b", b"object-ab")
+    names = [o.name for o in e.list_objects("b")]
+    assert names == ["a", "a/b"]
+    assert e.get_object("b", "a")[0] == b"object-a"
+    assert e.get_object("b", "a/b")[0] == b"object-ab"
