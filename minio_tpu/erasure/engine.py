@@ -30,12 +30,10 @@ from ..storage import errors as serr
 from ..storage.interface import StorageAPI
 from ..storage.metadata import (ErasureInfo, FileInfo, ObjectPartInfo,
                                 new_data_dir, new_version_id, now)
-from ..storage.xl import MINIO_META_BUCKET
+from ..storage.xl import MINIO_META_BUCKET, TMP_PATH
 from ..utils import ceil_frac
 from . import bitrot
 from .codec import BLOCK_SIZE, Erasure
-
-TMP_PATH = "tmp"
 
 _UUID_RE = __import__("re").compile(
     r"^[0-9a-f]{8}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{12}$")
@@ -102,6 +100,9 @@ class ErasureObjects:
         self.m = parity_shards
         self.block_size = block_size
         self.codec = Erasure(data_shards, parity_shards, block_size)
+        from .heal import Healer, MRFQueue
+        self.healer = Healer(self)
+        self.mrf = MRFQueue(self.healer)
 
     # ------------------------------------------------------------------
     # buckets
@@ -232,8 +233,10 @@ class ErasureObjects:
         _, errs = parallel_map(
             [lambda i=i: write_one(i) for i in range(n)])
         reduce_quorum_errs(errs, wq, "put_object")
-        # Partial failures feed the MRF heal queue (ref addPartial,
-        # cmd/erasure-object.go:1082) — wired when healing lands.
+        if any(e is not None for e in errs):
+            # Partial failure feeds the MRF heal queue (ref addPartial,
+            # cmd/erasure-object.go:1082).
+            self.mrf.add(bucket, object_name)
         return ObjectInfo(bucket=bucket, name=object_name, size=len(data),
                           etag=etag, mod_time=mod_time,
                           version_id=version_id, metadata=meta,
@@ -444,7 +447,9 @@ class ErasureObjects:
                     windows.pop(j, None)
                     if j in have:
                         have.remove(j)
-                    # heal required — signaled to the heal queue later
+                    # heal required (ref errHealRequired ->
+                    # deepHealObject, cmd/erasure-object.go:324)
+                    self.mrf.add(fi.volume, fi.name)
             if good < k:
                 raise QuorumError(
                     f"block {b}: only {good}/{k} shards valid", [])

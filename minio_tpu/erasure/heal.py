@@ -1,0 +1,344 @@
+"""Healing: converge damaged/missing shards back to full redundancy
+(ref cmd/erasure-healing.go:224 healObject, cmd/background-heal-ops.go,
+cmd/erasure-object.go:1082 MRF).
+
+heal_object classifies each disk for the latest quorum version —
+  ok        xl.meta agrees + shard passes bitrot verify
+  outdated  xl.meta missing/stale (disk swapped, partial write)
+  corrupt   shard fails deep bitrot scan
+— then regenerates every missing shard from k good ones and rewrites the
+bad disks via the same tmp→rename_data commit as a PUT. Reconstruction is
+the best TPU batch source: all blocks of an object share one erasure mask,
+so the whole object heals in a few batched device dispatches
+(SURVEY §7 stage 5).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import uuid
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..parallel.quorum import parallel_map
+from ..storage import errors as serr
+from ..storage.metadata import FileInfo
+from ..storage.xl import MINIO_META_BUCKET, TMP_PATH
+from ..utils import ceil_frac
+from . import bitrot
+from .codec import Erasure
+
+
+@dataclass
+class HealResult:
+    bucket: str
+    object_name: str
+    total_disks: int = 0
+    before_ok: int = 0
+    after_ok: int = 0
+    healed_disks: list[int] = field(default_factory=list)
+    corrupt_disks: list[int] = field(default_factory=list)
+    missing_disks: list[int] = field(default_factory=list)
+    dangling: bool = False
+
+    @property
+    def healthy(self) -> bool:
+        """Full redundancy restored: every disk holds a valid shard."""
+        return not self.dangling and self.after_ok == self.total_disks
+
+
+class Healer:
+    """Heal operations over an ErasureObjects engine."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    # -- classification ------------------------------------------------
+
+    def _classify(self, bucket: str, object_name: str,
+                  ) -> tuple[FileInfo, list[str]]:
+        """Returns (quorum FileInfo, per-disk state list:
+        'ok'|'outdated'|'corrupt')."""
+        eng = self.engine
+        fi, agreed = eng._quorum_file_info(bucket, object_name)
+
+        def check(i: int) -> str:
+            f = agreed[i]
+            if f is None:
+                return "outdated"
+            if fi.size == 0 or fi.deleted:
+                return "ok"
+            try:
+                eng.disks[i].verify_file(bucket, object_name, f)
+                return "ok"
+            except serr.FileCorrupt:
+                return "corrupt"
+            except serr.StorageError:
+                return "outdated"
+            except Exception:
+                return "outdated"
+
+        results, _ = parallel_map(
+            [lambda i=i: check(i) for i in range(len(eng.disks))])
+        states = list(results)
+        return fi, states
+
+    # -- object heal ---------------------------------------------------
+
+    def heal_object(self, bucket: str, object_name: str,
+                    dry_run: bool = False) -> HealResult:
+        from ..parallel.quorum import QuorumError
+        eng = self.engine
+        n_disks = len(eng.disks)
+        try:
+            fi, states = self._classify(bucket, object_name)
+        except QuorumError:
+            # Below metadata quorum: unrecoverable (ref dangling-object
+            # classification in healObject).
+            res = HealResult(bucket, object_name, total_disks=n_disks)
+            res.dangling = True
+            return res
+        res = HealResult(bucket, object_name, total_disks=n_disks)
+        res.before_ok = states.count("ok")
+        res.corrupt_disks = [i for i, s in enumerate(states)
+                             if s == "corrupt"]
+        res.missing_disks = [i for i, s in enumerate(states)
+                             if s == "outdated"]
+        bad = res.corrupt_disks + res.missing_disks
+        if not bad:
+            res.after_ok = res.before_ok
+            return res
+        k, m = fi.erasure.data_blocks, fi.erasure.parity_blocks
+        if res.before_ok < k:
+            res.dangling = True  # unrecoverable (ref dangling purge)
+            res.after_ok = res.before_ok
+            return res
+        if dry_run:
+            res.after_ok = res.before_ok
+            return res
+
+        # A fresh replacement disk may lack the bucket volume entirely —
+        # heal it first so shard/metadata writes land (ref healObject's
+        # implicit HealBucket dependency).
+        for i in bad:
+            try:
+                eng.disks[i].stat_volume(bucket)
+            except serr.VolumeNotFound:
+                try:
+                    eng.disks[i].make_volume(bucket)
+                except serr.StorageError:
+                    pass
+            except serr.StorageError:
+                pass
+
+        if fi.size == 0 or fi.deleted:
+            res.healed_disks = self._rewrite_meta_only(fi, bad)
+            res.after_ok = res.before_ok + len(res.healed_disks)
+            return res
+
+        # Shard indices (0-based) on good vs bad disks, via each good
+        # disk's own metadata index; bad disks get theirs from the quorum
+        # distribution.
+        dist = fi.erasure.distribution
+        good_disks = [i for i, s in enumerate(states) if s == "ok"]
+        shard_of_disk = {i: dist[i] - 1 for i in range(len(eng.disks))}
+
+        # Read all blocks from k good shards.
+        shard_size = fi.erasure.shard_size()
+        part_size = fi.parts[0].size if fi.parts else fi.size
+        n_blocks = ceil_frac(part_size, fi.erasure.block_size)
+        use = good_disks[:k]
+        streams = {}
+        for i in use:
+            f_dd = fi.data_dir
+            streams[shard_of_disk[i]] = eng.disks[i].read_all(
+                bucket, f"{object_name}/{f_dd}/part.1")
+
+        algo = bitrot.DEFAULT_ALGORITHM
+        for cs in fi.erasure.checksums:
+            if cs.get("part") == 1:
+                algo = cs.get("algorithm", algo)
+
+        # Rebuild the full shard matrix blockwise: one decode per block,
+        # shared mask across the object (batchable on TPU).
+        missing_shards = sorted(shard_of_disk[i] for i in bad)
+        rebuilt: dict[int, bytearray] = {j: bytearray()
+                                         for j in missing_shards}
+        codec = Erasure(k, m, fi.erasure.block_size)
+        for b in range(n_blocks):
+            blk_len = min(fi.erasure.block_size,
+                          part_size - b * fi.erasure.block_size)
+            chunk = ceil_frac(blk_len, k)
+            shards: list[np.ndarray | None] = [None] * (k + m)
+            for j, stream in streams.items():
+                data = bitrot.extract_block(stream, b, chunk, shard_size,
+                                            algo)
+                shards[j] = np.frombuffer(data, dtype=np.uint8)
+            full = codec.decode_all_blocks(shards)
+            for j in missing_shards:
+                rebuilt[j] += full[j].tobytes()
+
+        # Write regenerated shards to the bad disks (tmp -> rename_data,
+        # same commit path as PUT; ref Erasure.Heal writes via bitrot
+        # writers then writeUniqueFileInfo + rename).
+        def heal_one(i: int):
+            disk = eng.disks[i]
+            j = shard_of_disk[i]
+            stream = bitrot.encode_stream(bytes(rebuilt[j]), shard_size,
+                                          algo)
+            tmp_path = f"{TMP_PATH}/{uuid.uuid4()}"
+            try:
+                disk.create_file(MINIO_META_BUCKET,
+                                 f"{tmp_path}/{fi.data_dir}/part.1",
+                                 stream)
+                new_fi = FileInfo(
+                    volume=bucket, name=object_name,
+                    version_id=fi.version_id, data_dir=fi.data_dir,
+                    size=fi.size, mod_time=fi.mod_time,
+                    metadata=dict(fi.metadata), parts=list(fi.parts),
+                    erasure=type(fi.erasure)(
+                        algorithm=fi.erasure.algorithm,
+                        data_blocks=k, parity_blocks=m,
+                        block_size=fi.erasure.block_size,
+                        index=j + 1, distribution=list(dist),
+                        checksums=list(fi.erasure.checksums)),
+                )
+                disk.rename_data(MINIO_META_BUCKET, tmp_path, new_fi,
+                                 bucket, object_name)
+            except BaseException:
+                try:
+                    disk.delete(MINIO_META_BUCKET, tmp_path,
+                                recursive=True)
+                except Exception:
+                    pass
+                raise
+
+        _, errs = parallel_map([lambda i=i: heal_one(i) for i in bad])
+        res.healed_disks = [i for i, e in zip(bad, errs) if e is None]
+        res.after_ok = res.before_ok + len(res.healed_disks)
+        return res
+
+    def _rewrite_meta_only(self, fi: FileInfo, bad: list[int]) -> list[int]:
+        """Per-disk metadata rewrite; returns indices actually healed
+        (failures on individual disks don't abort the rest)."""
+        dist = fi.erasure.distribution
+
+        def one(i: int):
+            new_fi = FileInfo(
+                volume=fi.volume, name=fi.name, version_id=fi.version_id,
+                deleted=fi.deleted, data_dir=fi.data_dir, size=fi.size,
+                mod_time=fi.mod_time, metadata=dict(fi.metadata),
+                parts=list(fi.parts),
+                erasure=type(fi.erasure)(
+                    algorithm=fi.erasure.algorithm,
+                    data_blocks=fi.erasure.data_blocks,
+                    parity_blocks=fi.erasure.parity_blocks,
+                    block_size=fi.erasure.block_size,
+                    index=dist[i] if i < len(dist) else 0,
+                    distribution=list(dist),
+                    checksums=list(fi.erasure.checksums)),
+            )
+            self.engine.disks[i].write_metadata(fi.volume, fi.name, new_fi)
+
+        _, errs = parallel_map([lambda i=i: one(i) for i in bad])
+        return [i for i, e in zip(bad, errs) if e is None]
+
+    # -- bucket heal ---------------------------------------------------
+
+    def heal_bucket(self, bucket: str) -> list[int]:
+        """Create the bucket volume on disks where it's missing
+        (ref HealBucket)."""
+        eng = self.engine
+        healed = []
+        for i, disk in enumerate(eng.disks):
+            try:
+                disk.stat_volume(bucket)
+            except serr.VolumeNotFound:
+                try:
+                    disk.make_volume(bucket)
+                    healed.append(i)
+                except serr.StorageError:
+                    pass
+            except serr.StorageError:
+                pass
+        return healed
+
+    def heal_disk(self, disk_index: int) -> list[HealResult]:
+        """Full sweep healing everything onto one (fresh) disk
+        (ref healErasureSet / monitorLocalDisksAndHeal)."""
+        eng = self.engine
+        results = []
+        for binfo in eng.list_buckets():
+            bucket = binfo["name"]
+            self.heal_bucket(bucket)
+            for obj in eng.list_objects(bucket, max_keys=1_000_000):
+                r = self.heal_object(bucket, obj.name)
+                if disk_index in r.healed_disks or not r.healed_disks:
+                    results.append(r)
+        return results
+
+
+class MRFQueue:
+    """Most-recently-failed heal queue: partial PUT failures enqueue the
+    object for background healing (ref mrfOpCh, cmd/erasure-object.go:1082
+    + healRoutine, cmd/background-heal-ops.go:89)."""
+
+    def __init__(self, healer: Healer, maxsize: int = 10_000):
+        self.healer = healer
+        self.q: queue.Queue = queue.Queue(maxsize=maxsize)
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    def add(self, bucket: str, object_name: str) -> None:
+        try:
+            self.q.put_nowait((bucket, object_name))
+        except queue.Full:
+            return  # best effort, like the reference's buffered channel
+        # Background worker starts lazily on first failure so every
+        # deployment (server, library use) gets self-healing without
+        # explicit wiring.
+        if self._thread is None:
+            self.start()
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            try:
+                self.q.put_nowait(None)  # wake; Full is fine — the worker
+            except queue.Full:           # checks _stop after every item
+                pass
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def drain(self) -> None:
+        """Synchronously heal everything queued (tests/shutdown)."""
+        while True:
+            try:
+                item = self.q.get_nowait()
+            except queue.Empty:
+                return
+            if item is not None:
+                self._heal(item)
+
+    def _heal(self, item) -> None:
+        bucket, object_name = item
+        try:
+            self.healer.heal_object(bucket, object_name)
+        except Exception:
+            pass  # background best-effort
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            item = self.q.get()
+            if item is None or self._stop.is_set():
+                break
+            self._heal(item)

@@ -25,6 +25,9 @@ from ..erasure import bitrot
 
 MINIO_META_BUCKET = ".minio.sys"
 TMP_DIR = ".minio.sys/tmp"
+# Staging prefix inside the MINIO_META_BUCKET volume (engine + healer
+# share this single source of truth).
+TMP_PATH = "tmp"
 
 _RESERVED_VOLUMES = {MINIO_META_BUCKET}
 
@@ -60,6 +63,12 @@ class XLStorage(StorageAPI):
     def _check_vol(self, volume: str) -> str:
         p = self._vol_path(volume)
         if not os.path.isdir(p):
+            if volume == MINIO_META_BUCKET:
+                # The system volume self-creates (a freshly swapped disk
+                # must accept heal writes immediately).
+                os.makedirs(os.path.join(self.root, TMP_DIR),
+                            exist_ok=True)
+                return p
             raise serr.VolumeNotFound(volume)
         return p
 

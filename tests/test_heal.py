@@ -1,0 +1,190 @@
+"""Healing tests: the reference's erasure-healing_test.go pattern —
+delete/corrupt shard files on real dirs, heal, assert byte-identical
+convergence."""
+
+import json
+import os
+import shutil
+
+import pytest
+
+from minio_tpu.erasure.engine import ErasureObjects
+from minio_tpu.storage.xl import XLStorage
+
+from tests.test_engine import NaughtyDisk, make_engine  # noqa: F401
+
+
+def _shard_file(disk_root: str, bucket: str, obj: str) -> str:
+    obj_dir = os.path.join(disk_root, bucket, obj)
+    for entry in os.listdir(obj_dir):
+        p = os.path.join(obj_dir, entry)
+        if os.path.isdir(p):
+            return os.path.join(p, "part.1")
+    raise FileNotFoundError(obj_dir)
+
+
+def _disk_files_snapshot(e, bucket, obj):
+    out = {}
+    for i, d in enumerate(e.disks):
+        root = d.inner.root if isinstance(d, NaughtyDisk) else d.root
+        try:
+            p = _shard_file(root, bucket, obj)
+            out[i] = open(p, "rb").read()
+        except (FileNotFoundError, NotADirectoryError):
+            out[i] = None
+    return out
+
+
+@pytest.fixture
+def engine(tmp_path):
+    e = make_engine(tmp_path, n=6, block_size=8192)
+    e.make_bucket("b")
+    return e
+
+
+def test_heal_noop_on_healthy_object(engine):
+    engine.put_object("b", "fine", os.urandom(30000))
+    r = engine.healer.heal_object("b", "fine")
+    assert r.before_ok == 6
+    assert r.healed_disks == [] and not r.dangling
+
+
+def test_heal_after_shard_deletion(engine):
+    payload = os.urandom(50000)
+    engine.put_object("b", "obj", payload)
+    before = _disk_files_snapshot(engine, "b", "obj")
+    # Delete the whole object dir on two disks (disk swap scenario).
+    for i in (1, 4):
+        root = engine.disks[i].root
+        shutil.rmtree(os.path.join(root, "b", "obj"))
+    r = engine.healer.heal_object("b", "obj")
+    assert sorted(r.healed_disks) == [1, 4]
+    after = _disk_files_snapshot(engine, "b", "obj")
+    # Healed shard files are byte-identical to the originals.
+    assert after == before
+    got, _ = engine.get_object("b", "obj")
+    assert got == payload
+
+
+def test_heal_after_bitrot_corruption(engine):
+    payload = os.urandom(30000)
+    engine.put_object("b", "rotten", payload)
+    before = _disk_files_snapshot(engine, "b", "rotten")
+    p = _shard_file(engine.disks[2].root, "b", "rotten")
+    raw = bytearray(open(p, "rb").read())
+    raw[100] ^= 0x55
+    open(p, "wb").write(bytes(raw))
+    r = engine.healer.heal_object("b", "rotten")
+    assert r.corrupt_disks == [2]
+    assert r.healed_disks == [2]
+    assert _disk_files_snapshot(engine, "b", "rotten") == before
+
+
+def test_heal_dangling_object(engine):
+    engine.put_object("b", "gone", os.urandom(10000))
+    # Destroy shards beyond parity (4 of 6, k=3).
+    for i in range(4):
+        root = engine.disks[i].root
+        shutil.rmtree(os.path.join(root, "b", "gone"))
+    r = engine.healer.heal_object("b", "gone")
+    assert r.dangling
+    assert r.healed_disks == []
+
+
+def test_heal_dry_run_changes_nothing(engine):
+    engine.put_object("b", "dry", os.urandom(10000))
+    root = engine.disks[0].root
+    shutil.rmtree(os.path.join(root, "b", "dry"))
+    r = engine.healer.heal_object("b", "dry", dry_run=True)
+    assert r.missing_disks == [0]
+    assert not os.path.exists(os.path.join(root, "b", "dry"))
+
+
+def test_heal_bucket(engine):
+    # Drop the bucket dir on one disk.
+    shutil.rmtree(os.path.join(engine.disks[3].root, "b"))
+    healed = engine.healer.heal_bucket("b")
+    assert healed == [3]
+    assert os.path.isdir(os.path.join(engine.disks[3].root, "b"))
+
+
+def test_heal_fresh_disk_full_sweep(tmp_path):
+    """Wipe a whole disk (fresh replacement), sweep-heal everything back."""
+    e = make_engine(tmp_path, n=4, block_size=4096)
+    e.make_bucket("b")
+    payloads = {f"o{i}": os.urandom(6000 + i * 1000) for i in range(5)}
+    for name, p in payloads.items():
+        e.put_object("b", name, p)
+    wiped = e.disks[1].root
+    shutil.rmtree(wiped)
+    os.makedirs(wiped)
+    e.healer.heal_bucket("b")
+    e.healer.heal_disk(1)
+    # Every object readable AND disk 1 holds valid shards again.
+    for name, p in payloads.items():
+        got, _ = e.get_object("b", name)
+        assert got == p
+        assert os.path.exists(os.path.join(wiped, "b", name, "xl.meta"))
+
+
+def test_mrf_heals_partial_write(tmp_path):
+    """A PUT with one failed disk self-heals via the MRF queue."""
+    e = make_engine(tmp_path, n=4, naughty=True, block_size=4096)
+    e.make_bucket("b")
+    e.disks[3].fail_methods = {"create_file"}
+    payload = os.urandom(20000)
+    e.put_object("b", "partial", payload)
+    e.disks[3].fail_methods = set()
+    # The MRF worker starts lazily on enqueue; wait for convergence.
+    import time
+    root = e.disks[3].inner.root
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        e.mrf.drain()
+        if os.path.exists(os.path.join(root, "b", "partial", "xl.meta")):
+            break
+        time.sleep(0.05)
+    assert os.path.exists(os.path.join(root, "b", "partial", "xl.meta"))
+    r = e.healer.heal_object("b", "partial")
+    assert r.before_ok == 4
+    assert r.healthy
+
+
+def test_get_queues_heal_on_bitrot(engine):
+    payload = os.urandom(30000)
+    engine.put_object("b", "selfheal", payload)
+    # Corrupt the disk holding DATA shard index 1 (always read first).
+    target = None
+    for d in engine.disks:
+        meta = json.loads(open(os.path.join(
+            d.root, "b", "selfheal", "xl.meta")).read())
+        if meta["versions"][0]["erasure"]["index"] == 1:
+            target = d
+            break
+    assert target is not None
+    p = _shard_file(target.root, "b", "selfheal")
+    raw = bytearray(open(p, "rb").read())
+    raw[50] ^= 0xFF
+    open(p, "wb").write(bytes(raw))
+    got, _ = engine.get_object("b", "selfheal")
+    assert got == payload
+    # The bitrot hit queued a self-heal; the lazy MRF worker (or drain)
+    # converges it.
+    import time
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        engine.mrf.drain()
+        r = engine.healer.heal_object("b", "selfheal")
+        if r.corrupt_disks == [] and r.healthy:
+            break
+        time.sleep(0.05)
+    assert r.corrupt_disks == [] and r.healthy
+
+
+def test_heal_zero_byte_and_metadata_only(engine):
+    engine.put_object("b", "empty", b"")
+    shutil.rmtree(os.path.join(engine.disks[5].root, "b", "empty"))
+    r = engine.healer.heal_object("b", "empty")
+    assert r.healed_disks == [5]
+    got, _ = engine.get_object("b", "empty")
+    assert got == b""
