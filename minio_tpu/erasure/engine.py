@@ -101,8 +101,10 @@ class ErasureObjects:
         self.block_size = block_size
         self.codec = Erasure(data_shards, parity_shards, block_size)
         from .heal import Healer, MRFQueue
+        from .multipart import MultipartUploads
         self.healer = Healer(self)
         self.mrf = MRFQueue(self.healer)
+        self.multipart = MultipartUploads(self)
 
     # ------------------------------------------------------------------
     # buckets
@@ -357,10 +359,38 @@ class ErasureObjects:
     def _read_and_decode(self, fi: FileInfo,
                          agreed: list[FileInfo | None],
                          offset: int, length: int) -> bytes:
+        """Walk the object's parts, reading the covered range from each
+        (multipart objects carry one erasure-coded shard file per part,
+        ref cmd/erasure-object.go:240 per-part loop)."""
+        parts = fi.parts or [ObjectPartInfo(number=1, size=fi.size,
+                                            actual_size=fi.size)]
+        failed: set[int] = set()
+        out = bytearray()
+        pos = 0
+        for p in parts:
+            part_start, part_end = pos, pos + p.size
+            pos = part_end
+            if part_end <= offset or part_start >= offset + length:
+                continue
+            local_off = max(0, offset - part_start)
+            local_len = min(part_end, offset + length) - (
+                part_start + local_off)
+            out += self._read_part_range(fi, agreed, p.number, p.size,
+                                         local_off, local_len, failed)
+        return bytes(out)
+
+    def _read_part_range(self, fi: FileInfo,
+                         agreed: list[FileInfo | None],
+                         part_number: int, part_size: int,
+                         offset: int, length: int,
+                         failed: set[int]) -> bytes:
         k, m = fi.erasure.data_blocks, fi.erasure.parity_blocks
         shard_size = fi.erasure.shard_size()
         by_shard = self._shard_readers(fi, agreed)
-        part_size = fi.parts[0].size if fi.parts else fi.size
+        # Codec geometry comes from the object's metadata (it may differ
+        # from this engine's default).
+        codec = self.codec if (k, m) == (self.k, self.m) else \
+            Erasure(k, m, fi.erasure.block_size)
 
         # Block coverage of [offset, offset+length).
         start_block = offset // fi.erasure.block_size
@@ -371,7 +401,7 @@ class ErasureObjects:
         # current default — framing stride depends on it.
         algo = bitrot.DEFAULT_ALGORITHM
         for cs in fi.erasure.checksums:
-            if cs.get("part") == 1:
+            if cs.get("part") == part_number:
                 algo = cs.get("algorithm", algo)
 
         # Ranged shard-file window: each full block contributes
@@ -384,7 +414,6 @@ class ErasureObjects:
         win_off = start_block * stride
 
         windows: dict[int, bytes] = {}
-        failed: set[int] = set()
 
         def fetch(j: int) -> bool:
             """Fetch shard j's stream window; False if unavailable."""
@@ -396,7 +425,8 @@ class ErasureObjects:
             f = agreed[by_shard[j]]
             try:
                 windows[j] = disk.read_file(
-                    fi.volume, f"{fi.name}/{f.data_dir}/part.1",
+                    fi.volume,
+                    f"{fi.name}/{f.data_dir}/part.{part_number}",
                     win_off, n_cov * stride)
                 return True
             except Exception:
@@ -453,7 +483,7 @@ class ErasureObjects:
             if good < k:
                 raise QuorumError(
                     f"block {b}: only {good}/{k} shards valid", [])
-            decoded = self.codec.decode_data_blocks(shards) \
+            decoded = codec.decode_data_blocks(shards) \
                 if any(shards[j] is None for j in range(k)) else shards
             block_data = b"".join(
                 decoded[j].tobytes() for j in range(k))[:blk_len]

@@ -145,40 +145,43 @@ class Healer:
         good_disks = [i for i, s in enumerate(states) if s == "ok"]
         shard_of_disk = {i: dist[i] - 1 for i in range(len(eng.disks))}
 
-        # Read all blocks from k good shards.
+        # Rebuild every part's full shard matrix blockwise from k good
+        # shards: one decode per block, shared mask across the whole
+        # object (the best TPU batch source).
         shard_size = fi.erasure.shard_size()
-        part_size = fi.parts[0].size if fi.parts else fi.size
-        n_blocks = ceil_frac(part_size, fi.erasure.block_size)
         use = good_disks[:k]
-        streams = {}
-        for i in use:
-            f_dd = fi.data_dir
-            streams[shard_of_disk[i]] = eng.disks[i].read_all(
-                bucket, f"{object_name}/{f_dd}/part.1")
-
-        algo = bitrot.DEFAULT_ALGORITHM
-        for cs in fi.erasure.checksums:
-            if cs.get("part") == 1:
-                algo = cs.get("algorithm", algo)
-
-        # Rebuild the full shard matrix blockwise: one decode per block,
-        # shared mask across the object (batchable on TPU).
         missing_shards = sorted(shard_of_disk[i] for i in bad)
-        rebuilt: dict[int, bytearray] = {j: bytearray()
-                                         for j in missing_shards}
         codec = Erasure(k, m, fi.erasure.block_size)
-        for b in range(n_blocks):
-            blk_len = min(fi.erasure.block_size,
-                          part_size - b * fi.erasure.block_size)
-            chunk = ceil_frac(blk_len, k)
-            shards: list[np.ndarray | None] = [None] * (k + m)
-            for j, stream in streams.items():
-                data = bitrot.extract_block(stream, b, chunk, shard_size,
-                                            algo)
-                shards[j] = np.frombuffer(data, dtype=np.uint8)
-            full = codec.decode_all_blocks(shards)
-            for j in missing_shards:
-                rebuilt[j] += full[j].tobytes()
+        from ..storage.metadata import ObjectPartInfo
+        parts = fi.parts or [ObjectPartInfo(number=1, size=fi.size,
+                                            actual_size=fi.size)]
+        # rebuilt[part_number][shard_idx] -> bytes
+        rebuilt: dict[int, dict[int, bytearray]] = {}
+        for part in parts:
+            streams = {}
+            for i in use:
+                streams[shard_of_disk[i]] = eng.disks[i].read_all(
+                    bucket,
+                    f"{object_name}/{fi.data_dir}/part.{part.number}")
+            algo = bitrot.DEFAULT_ALGORITHM
+            for cs in fi.erasure.checksums:
+                if cs.get("part") == part.number:
+                    algo = cs.get("algorithm", algo)
+            n_blocks = ceil_frac(part.size, fi.erasure.block_size)
+            acc = {j: bytearray() for j in missing_shards}
+            for b in range(n_blocks):
+                blk_len = min(fi.erasure.block_size,
+                              part.size - b * fi.erasure.block_size)
+                chunk = ceil_frac(blk_len, k)
+                shards: list[np.ndarray | None] = [None] * (k + m)
+                for j, stream in streams.items():
+                    data = bitrot.extract_block(stream, b, chunk,
+                                                shard_size, algo)
+                    shards[j] = np.frombuffer(data, dtype=np.uint8)
+                full = codec.decode_all_blocks(shards)
+                for j in missing_shards:
+                    acc[j] += full[j].tobytes()
+            rebuilt[part.number] = acc
 
         # Write regenerated shards to the bad disks (tmp -> rename_data,
         # same commit path as PUT; ref Erasure.Heal writes via bitrot
@@ -186,13 +189,19 @@ class Healer:
         def heal_one(i: int):
             disk = eng.disks[i]
             j = shard_of_disk[i]
-            stream = bitrot.encode_stream(bytes(rebuilt[j]), shard_size,
-                                          algo)
             tmp_path = f"{TMP_PATH}/{uuid.uuid4()}"
             try:
-                disk.create_file(MINIO_META_BUCKET,
-                                 f"{tmp_path}/{fi.data_dir}/part.1",
-                                 stream)
+                for part in parts:
+                    algo = bitrot.DEFAULT_ALGORITHM
+                    for cs in fi.erasure.checksums:
+                        if cs.get("part") == part.number:
+                            algo = cs.get("algorithm", algo)
+                    stream = bitrot.encode_stream(
+                        bytes(rebuilt[part.number][j]), shard_size, algo)
+                    disk.create_file(
+                        MINIO_META_BUCKET,
+                        f"{tmp_path}/{fi.data_dir}/part.{part.number}",
+                        stream)
                 new_fi = FileInfo(
                     volume=bucket, name=object_name,
                     version_id=fi.version_id, data_dir=fi.data_dir,

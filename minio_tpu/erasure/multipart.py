@@ -1,0 +1,303 @@
+"""Multipart uploads: each part independently erasure-coded, complete
+stitches parts into one versioned object (ref cmd/erasure-multipart.go:
+NewMultipartUpload:314, PutObjectPart:342, CompleteMultipartUpload:678).
+
+On-disk (per disk, inside .minio.sys):
+    mpu/<obj-hash>/<upload_id>/upload.json   upload session record
+    mpu/<obj-hash>/<upload_id>/part.N        bitrot-wrapped shard of part N
+    mpu/<obj-hash>/<upload_id>/part.N.json   part metadata (size, etag)
+
+Complete moves the part shards into a fresh data dir and commits via the
+same rename_data path as a single PUT; the object's FileInfo carries the
+per-part sizes so ranged reads address (part, block) pairs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import uuid
+
+from ..parallel.quorum import (QuorumError, hash_order, parallel_map,
+                               reduce_quorum_errs, write_quorum)
+from ..storage import errors as serr
+from ..storage.metadata import (ErasureInfo, FileInfo, ObjectPartInfo,
+                                new_data_dir, now)
+from ..storage.xl import MINIO_META_BUCKET, TMP_PATH
+from . import bitrot
+
+MPU_PATH = "mpu"
+MIN_PART_SIZE = 5 * 1024 * 1024  # S3 minimum for all but the last part
+
+
+class UploadNotFound(Exception):
+    pass
+
+
+class InvalidPart(Exception):
+    pass
+
+
+class PartTooSmall(Exception):
+    pass
+
+
+def _upload_base(bucket: str, object_name: str, upload_id: str) -> str:
+    h = hashlib.sha256(f"{bucket}/{object_name}".encode()).hexdigest()[:16]
+    return f"{MPU_PATH}/{h}/{upload_id}"
+
+
+def multipart_etag(part_etags: list[str]) -> str:
+    """S3 multipart etag: md5 of concatenated binary part md5s + -N."""
+    binmd5 = b"".join(bytes.fromhex(e) for e in part_etags)
+    return f"{hashlib.md5(binmd5).hexdigest()}-{len(part_etags)}"
+
+
+class MultipartUploads:
+    """Multipart operations over an ErasureObjects engine."""
+
+    def __init__(self, engine, min_part_size: int = MIN_PART_SIZE):
+        self.engine = engine
+        self.min_part_size = min_part_size
+
+    # -- session ----------------------------------------------------------
+
+    def new_multipart_upload(self, bucket: str, object_name: str,
+                             metadata: dict | None = None) -> str:
+        eng = self.engine
+        eng._check_bucket(bucket)
+        upload_id = uuid.uuid4().hex
+        base = _upload_base(bucket, object_name, upload_id)
+        record = json.dumps({
+            "bucket": bucket, "object": object_name,
+            "meta": dict(metadata or {}), "created": now(),
+            "distribution": hash_order(f"{bucket}/{object_name}",
+                                       len(eng.disks)),
+        }).encode()
+        _, errs = parallel_map(
+            [lambda d=d: d.write_all(MINIO_META_BUCKET,
+                                     f"{base}/upload.json", record)
+             for d in eng.disks])
+        reduce_quorum_errs(errs, write_quorum(eng.k, eng.m),
+                           "new_multipart_upload")
+        return upload_id
+
+    def _load_upload(self, bucket: str, object_name: str,
+                     upload_id: str) -> dict:
+        base = _upload_base(bucket, object_name, upload_id)
+        for disk in self.engine.disks:
+            try:
+                return json.loads(
+                    disk.read_all(MINIO_META_BUCKET,
+                                  f"{base}/upload.json"))
+            except serr.StorageError:
+                continue
+        raise UploadNotFound(upload_id)
+
+    # -- parts ------------------------------------------------------------
+
+    def put_object_part(self, bucket: str, object_name: str,
+                        upload_id: str, part_number: int,
+                        data: bytes) -> dict:
+        eng = self.engine
+        if not 1 <= part_number <= 10000:
+            raise InvalidPart(f"part number {part_number}")
+        up = self._load_upload(bucket, object_name, upload_id)
+        dist = up["distribution"]
+        base = _upload_base(bucket, object_name, upload_id)
+        data = bytes(data)
+        etag = hashlib.md5(data).hexdigest()
+        shard_streams = eng._encode_object(data)
+        part_meta = json.dumps({"number": part_number, "size": len(data),
+                                "etag": etag}).encode()
+
+        def write_one(i: int):
+            disk = eng.disks[i]
+            j = dist[i] - 1
+            # Zero-byte parts still get an (empty) shard file so the
+            # commit/verify/heal paths see every part.N they expect.
+            disk.write_all(MINIO_META_BUCKET,
+                           f"{base}/part.{part_number}",
+                           shard_streams[j])
+            disk.write_all(MINIO_META_BUCKET,
+                           f"{base}/part.{part_number}.json", part_meta)
+
+        _, errs = parallel_map(
+            [lambda i=i: write_one(i) for i in range(len(eng.disks))])
+        reduce_quorum_errs(errs, write_quorum(eng.k, eng.m),
+                           "put_object_part")
+        return {"number": part_number, "size": len(data), "etag": etag}
+
+    def list_parts(self, bucket: str, object_name: str,
+                   upload_id: str) -> list[dict]:
+        """Union of part records across disks — a part missing on one
+        disk (tolerated by write quorum) must still be listable."""
+        self._load_upload(bucket, object_name, upload_id)
+        base = _upload_base(bucket, object_name, upload_id)
+        parts: dict[int, dict] = {}
+        for disk in self.engine.disks:
+            try:
+                entries = disk.list_dir(MINIO_META_BUCKET, base)
+            except serr.StorageError:
+                continue
+            for e in entries:
+                if e.startswith("part.") and e.endswith(".json"):
+                    try:
+                        rec = json.loads(disk.read_all(
+                            MINIO_META_BUCKET, f"{base}/{e}"))
+                    except serr.StorageError:
+                        continue
+                    parts.setdefault(rec["number"], rec)
+        return [parts[n] for n in sorted(parts)]
+
+    def list_uploads(self, bucket: str,
+                     prefix: str = "") -> list[dict]:
+        """All in-progress uploads for a bucket (scan the mpu tree)."""
+        eng = self.engine
+        out = []
+        seen = set()
+        for disk in eng.disks:
+            try:
+                hashes = disk.list_dir(MINIO_META_BUCKET, MPU_PATH)
+            except serr.StorageError:
+                continue
+            for h in hashes:
+                if not h.endswith("/"):
+                    continue
+                try:
+                    uploads = disk.list_dir(MINIO_META_BUCKET,
+                                            f"{MPU_PATH}/{h}")
+                except serr.StorageError:
+                    continue
+                for u in uploads:
+                    u = u.rstrip("/")
+                    if u in seen:
+                        continue
+                    try:
+                        rec = json.loads(disk.read_all(
+                            MINIO_META_BUCKET,
+                            f"{MPU_PATH}/{h}{u}/upload.json"))
+                    except serr.StorageError:
+                        continue
+                    if rec["bucket"] != bucket:
+                        continue
+                    if prefix and not rec["object"].startswith(prefix):
+                        continue
+                    seen.add(u)
+                    out.append({"upload_id": u, "object": rec["object"],
+                                "created": rec["created"]})
+        return sorted(out, key=lambda x: (x["object"], x["upload_id"]))
+
+    # -- complete / abort -------------------------------------------------
+
+    def complete_multipart_upload(self, bucket: str, object_name: str,
+                                  upload_id: str,
+                                  parts: list[tuple[int, str]]):
+        """parts: [(part_number, etag), ...] as sent by the client."""
+        eng = self.engine
+        up = self._load_upload(bucket, object_name, upload_id)
+        dist = up["distribution"]
+        base = _upload_base(bucket, object_name, upload_id)
+        have = {p["number"]: p for p in self.list_parts(
+            bucket, object_name, upload_id)}
+
+        # Validate the client's part list (ref CompleteMultipartUpload
+        # part checks).
+        if not parts:
+            raise InvalidPart("empty part list")
+        last_idx = len(parts) - 1
+        prev = 0
+        part_infos: list[ObjectPartInfo] = []
+        for idx, (num, etag) in enumerate(parts):
+            if num <= prev:
+                raise InvalidPart("parts not in ascending order")
+            prev = num
+            meta = have.get(num)
+            if meta is None or meta["etag"].strip('"') != etag.strip('"'):
+                raise InvalidPart(f"part {num}")
+            if idx != last_idx and meta["size"] < self.min_part_size:
+                raise PartTooSmall(f"part {num}: {meta['size']} bytes")
+            part_infos.append(ObjectPartInfo(
+                number=num, size=meta["size"], actual_size=meta["size"],
+                etag=meta["etag"]))
+
+        total_size = sum(p.size for p in part_infos)
+        etag = multipart_etag([p.etag for p in part_infos])
+        data_dir = new_data_dir()
+        mod_time = now()
+        meta = dict(up.get("meta") or {})
+        meta["etag"] = etag
+        wq = write_quorum(eng.k, eng.m)
+
+        def commit_one(i: int):
+            disk = eng.disks[i]
+            tmp_path = f"{TMP_PATH}/{uuid.uuid4()}"
+            try:
+                # COPY this disk's part shards into the staging data dir,
+                # renumbered to the client's part order (1..P). Copy, not
+                # rename: a failed quorum must leave the upload intact so
+                # the client can retry complete (cleanup happens only
+                # after quorum success).
+                if total_size > 0:
+                    for new_num, p in enumerate(part_infos, start=1):
+                        shard = disk.read_all(MINIO_META_BUCKET,
+                                              f"{base}/part.{p.number}")
+                        disk.create_file(
+                            MINIO_META_BUCKET,
+                            f"{tmp_path}/{data_dir}/part.{new_num}",
+                            shard)
+                fi = FileInfo(
+                    volume=bucket, name=object_name, version_id="",
+                    data_dir=data_dir if total_size > 0 else "",
+                    size=total_size, mod_time=mod_time, metadata=meta,
+                    parts=[ObjectPartInfo(number=n, size=p.size,
+                                          actual_size=p.actual_size,
+                                          etag=p.etag)
+                           for n, p in enumerate(part_infos, start=1)],
+                    erasure=ErasureInfo(
+                        data_blocks=eng.k, parity_blocks=eng.m,
+                        block_size=eng.block_size, index=dist[i],
+                        distribution=list(dist),
+                        checksums=[{"part": n,
+                                    "algorithm": bitrot.DEFAULT_ALGORITHM,
+                                    "hash": ""}
+                                   for n in range(1,
+                                                  len(part_infos) + 1)]),
+                )
+                if total_size > 0:
+                    disk.rename_data(MINIO_META_BUCKET, tmp_path, fi,
+                                     bucket, object_name)
+                else:
+                    disk.write_metadata(bucket, object_name, fi)
+                return fi
+            except BaseException:
+                try:
+                    disk.delete(MINIO_META_BUCKET, tmp_path,
+                                recursive=True)
+                except Exception:
+                    pass
+                raise
+
+        _, errs = parallel_map(
+            [lambda i=i: commit_one(i) for i in range(len(eng.disks))])
+        reduce_quorum_errs(errs, wq, "complete_multipart_upload")
+        if any(e is not None for e in errs):
+            eng.mrf.add(bucket, object_name)
+        self._cleanup(bucket, object_name, upload_id)
+
+        from .engine import ObjectInfo
+        return ObjectInfo(bucket=bucket, name=object_name,
+                          size=total_size, etag=etag, mod_time=mod_time,
+                          metadata=meta, parts=part_infos)
+
+    def abort_multipart_upload(self, bucket: str, object_name: str,
+                               upload_id: str) -> None:
+        self._load_upload(bucket, object_name, upload_id)
+        self._cleanup(bucket, object_name, upload_id)
+
+    def _cleanup(self, bucket: str, object_name: str,
+                 upload_id: str) -> None:
+        base = _upload_base(bucket, object_name, upload_id)
+        parallel_map(
+            [lambda d=d: d.delete(MINIO_META_BUCKET, base, recursive=True)
+             for d in self.engine.disks])

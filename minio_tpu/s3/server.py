@@ -353,6 +353,122 @@ class S3ApiHandlers:
             return S3Response(206, data, headers)
         return S3Response(200, data, headers)
 
+    # ---------------- multipart ----------------
+
+    def initiate_multipart(self, req: S3Request) -> S3Response:
+        from ..erasure.engine import BucketNotFound as BNF
+        meta = {"content-type": req.headers.get(
+            "content-type", "application/octet-stream")}
+        for k, v in req.headers.items():
+            if k.startswith("x-amz-meta-"):
+                meta[k] = v
+        try:
+            upload_id = self.layer.multipart.new_multipart_upload(
+                req.bucket, req.key, meta)
+        except BNF:
+            raise s3err.ERR_NO_SUCH_BUCKET
+        root = Element("InitiateMultipartUploadResult", S3_XMLNS)
+        root.child("Bucket", req.bucket)
+        root.child("Key", req.key)
+        root.child("UploadId", upload_id)
+        return S3Response(200, root.tobytes(),
+                          {"Content-Type": "application/xml"})
+
+    def put_part(self, req: S3Request) -> S3Response:
+        from ..erasure.multipart import InvalidPart, UploadNotFound
+        if len(req.body) > MAX_OBJECT_SIZE:
+            raise s3err.ERR_ENTITY_TOO_LARGE
+        md5_header = req.headers.get("content-md5", "")
+        if md5_header:
+            if hashlib.md5(req.body).digest() != base64.b64decode(
+                    md5_header):
+                raise s3err.ERR_BAD_DIGEST
+        try:
+            part = self.layer.multipart.put_object_part(
+                req.bucket, req.key, req.params["uploadId"],
+                int(req.params["partNumber"]), req.body)
+        except UploadNotFound:
+            raise s3err.ERR_NO_SUCH_UPLOAD
+        except (InvalidPart, ValueError):
+            raise s3err.ERR_INVALID_ARGUMENT
+        return S3Response(200, headers={"ETag": f'"{part["etag"]}"'})
+
+    def complete_multipart(self, req: S3Request) -> S3Response:
+        from ..erasure.multipart import (InvalidPart, PartTooSmall,
+                                         UploadNotFound)
+        try:
+            doc = parse(req.body)
+            parts = [(int(p.findtext("PartNumber")),
+                      (p.findtext("ETag") or "").strip('"'))
+                     for p in doc.findall("Part")]
+        except Exception:
+            raise s3err.ERR_MALFORMED_XML
+        try:
+            info = self.layer.multipart.complete_multipart_upload(
+                req.bucket, req.key, req.params["uploadId"], parts)
+        except UploadNotFound:
+            raise s3err.ERR_NO_SUCH_UPLOAD
+        except PartTooSmall:
+            raise s3err.ERR_ENTITY_TOO_SMALL
+        except InvalidPart as e:
+            if "ascending" in str(e):
+                raise s3err.ERR_INVALID_PART_ORDER
+            raise s3err.ERR_INVALID_PART
+        root = Element("CompleteMultipartUploadResult", S3_XMLNS)
+        root.child("Location",
+                   f"http://{req.headers.get('host', '')}"
+                   f"/{req.bucket}/{req.key}")
+        root.child("Bucket", req.bucket)
+        root.child("Key", req.key)
+        root.child("ETag", f'"{info.etag}"')
+        return S3Response(200, root.tobytes(),
+                          {"Content-Type": "application/xml"})
+
+    def abort_multipart(self, req: S3Request) -> S3Response:
+        from ..erasure.multipart import UploadNotFound
+        try:
+            self.layer.multipart.abort_multipart_upload(
+                req.bucket, req.key, req.params["uploadId"])
+        except UploadNotFound:
+            raise s3err.ERR_NO_SUCH_UPLOAD
+        return S3Response(204)
+
+    def list_parts(self, req: S3Request) -> S3Response:
+        from ..erasure.multipart import UploadNotFound
+        try:
+            parts = self.layer.multipart.list_parts(
+                req.bucket, req.key, req.params["uploadId"])
+        except UploadNotFound:
+            raise s3err.ERR_NO_SUCH_UPLOAD
+        root = Element("ListPartsResult", S3_XMLNS)
+        root.child("Bucket", req.bucket)
+        root.child("Key", req.key)
+        root.child("UploadId", req.params["uploadId"])
+        root.child("IsTruncated", False)
+        for p in parts:
+            e = root.child("Part")
+            e.child("PartNumber", p["number"])
+            e.child("ETag", f'"{p["etag"]}"')
+            e.child("Size", p["size"])
+        return S3Response(200, root.tobytes(),
+                          {"Content-Type": "application/xml"})
+
+    def list_multipart_uploads(self, req: S3Request) -> S3Response:
+        if not self.layer.bucket_exists(req.bucket):
+            raise s3err.ERR_NO_SUCH_BUCKET
+        uploads = self.layer.multipart.list_uploads(
+            req.bucket, req.params.get("prefix", ""))
+        root = Element("ListMultipartUploadsResult", S3_XMLNS)
+        root.child("Bucket", req.bucket)
+        root.child("IsTruncated", False)
+        for u in uploads:
+            e = root.child("Upload")
+            e.child("Key", u["object"])
+            e.child("UploadId", u["upload_id"])
+            e.child("Initiated", _iso8601(u["created"]))
+        return S3Response(200, root.tobytes(),
+                          {"Content-Type": "application/xml"})
+
     def delete_object(self, req: S3Request) -> S3Response:
         version_id = req.params.get("versionId", "")
         try:
@@ -412,8 +528,20 @@ class S3Server:
             if m == "GET":
                 if "location" in p:
                     return h.get_location(req)
+                if "uploads" in p:
+                    return h.list_multipart_uploads(req)
                 return h.list_objects(req)
             raise s3err.ERR_METHOD_NOT_ALLOWED
+        if m == "POST" and "uploads" in p:
+            return h.initiate_multipart(req)
+        if m == "POST" and "uploadId" in p:
+            return h.complete_multipart(req)
+        if m == "PUT" and "partNumber" in p and "uploadId" in p:
+            return h.put_part(req)
+        if m == "DELETE" and "uploadId" in p:
+            return h.abort_multipart(req)
+        if m == "GET" and "uploadId" in p:
+            return h.list_parts(req)
         if m == "PUT":
             return h.put_object(req)
         if m == "GET":

@@ -1,0 +1,223 @@
+"""Multipart upload tests — engine level and S3 API level
+(ref cmd/erasure-multipart.go semantics)."""
+
+import hashlib
+import os
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from minio_tpu.erasure.multipart import (InvalidPart, PartTooSmall,
+                                         UploadNotFound, multipart_etag)
+from tests.test_engine import make_engine
+
+
+@pytest.fixture
+def engine(tmp_path):
+    e = make_engine(tmp_path, n=4, block_size=16 * 1024)
+    e.multipart.min_part_size = 1024  # keep tests small
+    e.make_bucket("b")
+    return e
+
+
+def test_multipart_roundtrip(engine):
+    mp = engine.multipart
+    uid = mp.new_multipart_upload("b", "big.bin", {"content-type": "x/y"})
+    parts_data = [os.urandom(40_000), os.urandom(50_000),
+                  os.urandom(7_000)]
+    sent = []
+    for i, pd in enumerate(parts_data, start=1):
+        p = mp.put_object_part("b", "big.bin", uid, i, pd)
+        assert p["etag"] == hashlib.md5(pd).hexdigest()
+        sent.append((i, p["etag"]))
+    info = mp.complete_multipart_upload("b", "big.bin", uid, sent)
+    want = b"".join(parts_data)
+    assert info.size == len(want)
+    assert info.etag == multipart_etag([e for _, e in sent])
+    got, ginfo = engine.get_object("b", "big.bin")
+    assert got == want
+    assert len(ginfo.parts) == 3
+    # Ranged read across a part boundary.
+    got, _ = engine.get_object("b", "big.bin", offset=39_990, length=100)
+    assert got == want[39_990:40_090]
+    # Upload session cleaned up.
+    with pytest.raises(UploadNotFound):
+        mp.list_parts("b", "big.bin", uid)
+
+
+def test_multipart_part_overwrite(engine):
+    mp = engine.multipart
+    uid = mp.new_multipart_upload("b", "o")
+    mp.put_object_part("b", "o", uid, 1, b"x" * 2000)
+    p = mp.put_object_part("b", "o", uid, 1, b"y" * 3000)  # re-upload
+    mp.complete_multipart_upload("b", "o", uid, [(1, p["etag"])])
+    got, _ = engine.get_object("b", "o")
+    assert got == b"y" * 3000
+
+
+def test_multipart_validation(engine):
+    mp = engine.multipart
+    uid = mp.new_multipart_upload("b", "v")
+    p1 = mp.put_object_part("b", "v", uid, 1, b"a" * 2000)
+    p2 = mp.put_object_part("b", "v", uid, 2, b"b" * 2000)
+    # Wrong order.
+    with pytest.raises(InvalidPart):
+        mp.complete_multipart_upload("b", "v", uid,
+                                     [(2, p2["etag"]), (1, p1["etag"])])
+    # Wrong etag.
+    with pytest.raises(InvalidPart):
+        mp.complete_multipart_upload("b", "v", uid, [(1, "deadbeef")])
+    # Missing part.
+    with pytest.raises(InvalidPart):
+        mp.complete_multipart_upload("b", "v", uid, [(7, p1["etag"])])
+    # Too-small non-last part (part 2 under min when part 3 follows).
+    tiny = mp.put_object_part("b", "v", uid, 2, b"tiny")
+    big = mp.put_object_part("b", "v", uid, 3, b"c" * 2000)
+    with pytest.raises(PartTooSmall):
+        mp.complete_multipart_upload(
+            "b", "v", uid, [(2, tiny["etag"]), (3, big["etag"])])
+
+
+def test_multipart_abort(engine):
+    mp = engine.multipart
+    uid = mp.new_multipart_upload("b", "aborted")
+    mp.put_object_part("b", "aborted", uid, 1, b"z" * 5000)
+    assert mp.list_uploads("b")
+    mp.abort_multipart_upload("b", "aborted", uid)
+    assert mp.list_uploads("b") == []
+    with pytest.raises(UploadNotFound):
+        mp.put_object_part("b", "aborted", uid, 2, b"more")
+
+
+def test_multipart_heal(engine):
+    """A completed multipart object heals like any other."""
+    import shutil
+    mp = engine.multipart
+    uid = mp.new_multipart_upload("b", "healmp")
+    sent = []
+    datas = [os.urandom(30_000), os.urandom(20_000)]
+    for i, pd in enumerate(datas, start=1):
+        p = mp.put_object_part("b", "healmp", uid, i, pd)
+        sent.append((i, p["etag"]))
+    mp.complete_multipart_upload("b", "healmp", uid, sent)
+    root = engine.disks[2].root
+    shutil.rmtree(os.path.join(root, "b", "healmp"))
+    r = engine.healer.heal_object("b", "healmp")
+    assert r.healed_disks == [2] and r.healthy
+    got, _ = engine.get_object("b", "healmp")
+    assert got == b"".join(datas)
+
+
+# ---- S3 API level ----
+
+
+def _xml(body):
+    root = ET.fromstring(body)
+    for el in root.iter():
+        if "}" in el.tag:
+            el.tag = el.tag.split("}", 1)[1]
+    return root
+
+
+def test_s3_multipart_flow(tmp_path):
+    from minio_tpu.s3.client import S3Client
+    from minio_tpu.s3.server import S3Server
+
+    e = make_engine(tmp_path, n=4, block_size=32 * 1024)
+    e.multipart.min_part_size = 1024
+    srv = S3Server(e, "ak", "sk")
+    port = srv.start()
+    try:
+        c = S3Client("127.0.0.1", port, "ak", "sk")
+        c.make_bucket("mpu")
+        r = c.request("POST", "/mpu/video.bin", query="uploads=")
+        assert r.status == 200
+        uid = _xml(r.body).findtext("UploadId")
+
+        datas = [os.urandom(60_000), os.urandom(45_000)]
+        etags = []
+        for i, d in enumerate(datas, start=1):
+            r = c.request("PUT", "/mpu/video.bin",
+                          query=f"partNumber={i}&uploadId={uid}", body=d)
+            assert r.status == 200
+            etags.append(r.headers["etag"].strip('"'))
+
+        # List parts.
+        r = c.request("GET", "/mpu/video.bin", query=f"uploadId={uid}")
+        nums = [p.findtext("PartNumber")
+                for p in _xml(r.body).iter("Part")]
+        assert nums == ["1", "2"]
+
+        body = ("<CompleteMultipartUpload>" + "".join(
+            f"<Part><PartNumber>{i}</PartNumber><ETag>{e}</ETag></Part>"
+            for i, e in enumerate(etags, start=1)) +
+            "</CompleteMultipartUpload>").encode()
+        r = c.request("POST", "/mpu/video.bin", query=f"uploadId={uid}",
+                      body=body)
+        assert r.status == 200
+        etag = _xml(r.body).findtext("ETag").strip('"')
+        assert etag.endswith("-2")
+
+        r = c.get_object("mpu", "video.bin")
+        assert r.status == 200
+        assert r.body == b"".join(datas)
+
+        # Abort on unknown id -> NoSuchUpload.
+        r = c.request("DELETE", "/mpu/video.bin",
+                      query="uploadId=deadbeef")
+        assert r.status == 404
+        assert b"NoSuchUpload" in r.body
+    finally:
+        srv.stop()
+
+
+def test_zero_byte_final_part_heals(engine):
+    """A zero-byte last part must not make the object unhealable."""
+    mp = engine.multipart
+    uid = mp.new_multipart_upload("b", "zlast")
+    p1 = mp.put_object_part("b", "zlast", uid, 1, b"d" * 5000)
+    p2 = mp.put_object_part("b", "zlast", uid, 2, b"")
+    mp.complete_multipart_upload("b", "zlast", uid,
+                                 [(1, p1["etag"]), (2, p2["etag"])])
+    got, _ = engine.get_object("b", "zlast")
+    assert got == b"d" * 5000
+    r = engine.healer.heal_object("b", "zlast")
+    assert not r.dangling and r.corrupt_disks == []
+
+
+def test_complete_retry_after_partial_failure(tmp_path):
+    """A failed complete (below quorum) leaves the upload intact for
+    retry."""
+    from minio_tpu.parallel.quorum import QuorumError
+    e = make_engine(tmp_path, n=4, naughty=True, block_size=16 * 1024)
+    e.multipart.min_part_size = 1024
+    e.make_bucket("b")
+    mp = e.multipart
+    uid = mp.new_multipart_upload("b", "retry")
+    p = mp.put_object_part("b", "retry", uid, 1, os.urandom(30_000))
+    for i in (0, 1):
+        e.disks[i].fail_methods = {"rename_data"}
+    with pytest.raises(QuorumError):
+        mp.complete_multipart_upload("b", "retry", uid, [(1, p["etag"])])
+    for i in (0, 1):
+        e.disks[i].fail_methods = set()
+    info = mp.complete_multipart_upload("b", "retry", uid,
+                                        [(1, p["etag"])])
+    assert info.size == 30_000
+    got, _ = e.get_object("b", "retry")
+    assert len(got) == 30_000
+
+
+def test_list_parts_unions_across_disks(tmp_path):
+    """A part write that failed on one disk still lists."""
+    e = make_engine(tmp_path, n=4, naughty=True, block_size=16 * 1024)
+    e.multipart.min_part_size = 1024
+    e.make_bucket("b")
+    mp = e.multipart
+    uid = mp.new_multipart_upload("b", "u")
+    e.disks[0].fail_methods = {"write_all"}
+    p = mp.put_object_part("b", "u", uid, 1, b"q" * 4000)
+    e.disks[0].fail_methods = set()
+    parts = mp.list_parts("b", "u", uid)
+    assert [x["number"] for x in parts] == [1]
+    assert parts[0]["etag"] == p["etag"]
