@@ -29,25 +29,66 @@ def main(argv: list[str] | None = None) -> int:
     return 2
 
 
-def _serve(args) -> int:
-    from .erasure.engine import ErasureObjects
-    from .s3.server import S3Server
-    from .storage.xl import XLStorage
-    from .utils.ellipses import expand_all
+def build_object_layer(disk_args: list[str],
+                       block_size: int | None = None):
+    """Construct the full topology: per-arg pools -> format.json
+    bootstrap -> erasure sets -> server pools (ref newObjectLayer,
+    cmd/server-main.go:538)."""
+    import threading
 
-    disk_paths = expand_all(args.disks)
-    if len(disk_paths) < 2:
-        print("error: need at least 2 disks for erasure coding",
-              file=sys.stderr)
-        return 1
-    for p in disk_paths:
-        os.makedirs(p, exist_ok=True)
-    disks = [XLStorage(p) for p in disk_paths]
+    from .erasure.pools import ErasureServerPools
+    from .erasure.sets import ErasureSets
+    from .storage.format import init_or_load_formats
+    from .storage.xl import XLStorage
+    from .utils.ellipses import expand, has_ellipses
+
+    # Each ellipses arg is a pool; plain args group into one pool
+    # (ref createServerEndpoints, cmd/endpoint-ellipses.go:252).
+    pool_paths: list[list[str]] = []
+    if any(has_ellipses(a) for a in disk_args):
+        for a in disk_args:
+            pool_paths.append(expand(a))
+    else:
+        pool_paths.append(list(disk_args))
 
     kwargs = {}
-    if args.block_size:
-        kwargs["block_size"] = args.block_size
-    layer = ErasureObjects(disks, **kwargs)
+    if block_size:
+        kwargs["block_size"] = block_size
+
+    pools = []
+    fresh_all: list[tuple[ErasureSets, int]] = []
+    for paths in pool_paths:
+        if len(paths) < 2:
+            raise ValueError("each pool needs at least 2 disks")
+        for p in paths:
+            os.makedirs(p, exist_ok=True)
+        disks = [XLStorage(p) for p in paths]
+        fmt, ordered, fresh = init_or_load_formats(disks)
+        layout = [len(s) for s in fmt.sets]
+        sets = ErasureSets(ordered, layout, fmt.deployment_id, **kwargs)
+        pools.append(sets)
+        for slot in fresh:
+            fresh_all.append((sets, slot))
+
+    layer = ErasureServerPools(pools)
+    if fresh_all:
+        # Replacement disks detected: heal each affected pool once, in
+        # the background (ref monitorLocalDisksAndHeal).
+        unique_sets = list(dict.fromkeys(s for s, _ in fresh_all))
+        threading.Thread(target=lambda: [s.healer.heal_all()
+                                         for s in unique_sets],
+                         daemon=True).start()
+    return layer
+
+
+def _serve(args) -> int:
+    from .s3.server import S3Server
+
+    try:
+        layer = build_object_layer(args.disks, args.block_size)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
 
     host, _, port_s = args.address.rpartition(":")
     host = host or "0.0.0.0"
@@ -56,8 +97,12 @@ def _serve(args) -> int:
     server = S3Server(layer, access, secret)
     port = server.start(host, int(port_s))
 
-    print(f"minio-tpu server: {len(disks)} disks, "
-          f"EC {layer.k}+{layer.m}, listening on {host}:{port}")
+    n_disks = sum(len(s.disks) for p in layer.pools for s in p.sets)
+    eng = layer.pools[0].sets[0]
+    print(f"minio-tpu server: {len(layer.pools)} pool(s), "
+          f"{sum(len(p.sets) for p in layer.pools)} set(s), "
+          f"{n_disks} disks, EC {eng.k}+{eng.m}, "
+          f"listening on {host}:{port}")
     print(f"   access key: {access}")
     sys.stdout.flush()
 

@@ -1,0 +1,208 @@
+"""ErasureServerPools — the top-level ObjectLayer: N pools of erasure
+sets; writes go to the pool with the most free space unless the object
+already exists in another pool (ref cmd/erasure-server-pool.go:42 struct,
+:215 getServerPoolsAvailableSpace, :593 PutObject, :524 GetObjectNInfo).
+"""
+
+from __future__ import annotations
+
+from ..parallel.quorum import parallel_map
+from .engine import (BucketExists, BucketNotFound, ObjectInfo,
+                     ObjectNotFound)
+from .sets import ErasureSets, fan_out_bucket_op
+
+
+class ErasureServerPools:
+    def __init__(self, pools: list[ErasureSets]):
+        if not pools:
+            raise ValueError("need at least one pool")
+        self.pools = pools
+
+    # -- placement ------------------------------------------------------
+
+    def _pool_free_space(self, pool: ErasureSets) -> int:
+        total = 0
+        for s in pool.sets:
+            for d in s.disks:
+                try:
+                    total += d.disk_info()["free"]
+                except Exception:
+                    pass
+        return total
+
+    def _pool_with_object(self, bucket: str, object_name: str,
+                          ) -> int | None:
+        """Only a definitive not-found means 'not here'; any other error
+        (quorum loss, I/O) aborts placement rather than risking a write
+        landing in a second pool and later serving stale data."""
+        for i, pool in enumerate(self.pools):
+            try:
+                pool.get_object_info(bucket, object_name)
+                return i
+            except (ObjectNotFound, BucketNotFound):
+                continue
+        return None
+
+    def _put_pool_index(self, bucket: str, object_name: str) -> int:
+        if len(self.pools) == 1:
+            return 0
+        existing = self._pool_with_object(bucket, object_name)
+        if existing is not None:
+            return existing
+        frees = [self._pool_free_space(p) for p in self.pools]
+        return max(range(len(frees)), key=lambda i: frees[i])
+
+    # -- buckets --------------------------------------------------------
+
+    def make_bucket(self, bucket: str) -> None:
+        fan_out_bucket_op(self.pools, "make_bucket", BucketExists, bucket)
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        fan_out_bucket_op(self.pools, "delete_bucket", BucketNotFound,
+                          bucket, force=force)
+
+    def list_buckets(self) -> list[dict]:
+        return self.pools[0].list_buckets()
+
+    def bucket_exists(self, bucket: str) -> bool:
+        return self.pools[0].bucket_exists(bucket)
+
+    # -- objects --------------------------------------------------------
+
+    def put_object(self, bucket: str, object_name: str, data: bytes,
+                   metadata: dict | None = None,
+                   versioned: bool = False) -> ObjectInfo:
+        idx = self._put_pool_index(bucket, object_name)
+        return self.pools[idx].put_object(bucket, object_name, data,
+                                          metadata=metadata,
+                                          versioned=versioned)
+
+    def _probe(self, bucket: str, object_name: str, op):
+        """Try each pool in order; first hit wins (ref pool probe loop,
+        cmd/erasure-server-pool.go:569-593)."""
+        last: Exception = ObjectNotFound(f"{bucket}/{object_name}")
+        for pool in self.pools:
+            try:
+                return op(pool)
+            except ObjectNotFound as e:
+                last = e
+            except BucketNotFound as e:
+                last = e
+        raise last
+
+    def get_object(self, bucket: str, object_name: str, offset: int = 0,
+                   length: int = -1, version_id: str = ""):
+        return self._probe(bucket, object_name,
+                           lambda p: p.get_object(
+                               bucket, object_name, offset=offset,
+                               length=length, version_id=version_id))
+
+    def get_object_info(self, bucket: str, object_name: str,
+                        version_id: str = "") -> ObjectInfo:
+        return self._probe(bucket, object_name,
+                           lambda p: p.get_object_info(
+                               bucket, object_name, version_id))
+
+    def delete_object(self, bucket: str, object_name: str,
+                      version_id: str = "") -> None:
+        return self._probe(bucket, object_name,
+                           lambda p: p.delete_object(
+                               bucket, object_name, version_id))
+
+    def list_objects(self, bucket: str, prefix: str = "",
+                     max_keys: int = 1000) -> list[ObjectInfo]:
+        per_pool, _ = parallel_map(
+            [lambda p=p: p.list_objects(bucket, prefix=prefix,
+                                        max_keys=max_keys)
+             for p in self.pools])
+        merged: list[ObjectInfo] = []
+        seen: set[str] = set()
+        for lst in per_pool:
+            for o in lst or []:
+                if o.name not in seen:
+                    seen.add(o.name)
+                    merged.append(o)
+        merged.sort(key=lambda o: o.name)
+        return merged[:max_keys]
+
+    # -- multipart ------------------------------------------------------
+
+    @property
+    def multipart(self):
+        return _PoolsMultipart(self)
+
+    @property
+    def healer(self):
+        return _PoolsHealer(self)
+
+
+class _PoolsMultipart:
+    def __init__(self, pools: ErasureServerPools):
+        self._pools = pools
+
+    def _pool_for_upload(self, bucket, object_name, upload_id):
+        from .multipart import UploadNotFound
+        for pool in self._pools.pools:
+            try:
+                # Cheap existence probe of the upload record only.
+                pool.set_for(object_name).multipart._load_upload(
+                    bucket, object_name, upload_id)
+                return pool
+            except UploadNotFound:
+                continue
+        raise UploadNotFound(upload_id)
+
+    def new_multipart_upload(self, bucket, object_name, metadata=None):
+        idx = self._pools._put_pool_index(bucket, object_name)
+        return self._pools.pools[idx].multipart.new_multipart_upload(
+            bucket, object_name, metadata)
+
+    def put_object_part(self, bucket, object_name, upload_id,
+                        part_number, data):
+        pool = self._pool_for_upload(bucket, object_name, upload_id)
+        return pool.multipart.put_object_part(
+            bucket, object_name, upload_id, part_number, data)
+
+    def list_parts(self, bucket, object_name, upload_id):
+        pool = self._pool_for_upload(bucket, object_name, upload_id)
+        return pool.multipart.list_parts(bucket, object_name, upload_id)
+
+    def complete_multipart_upload(self, bucket, object_name, upload_id,
+                                  parts):
+        pool = self._pool_for_upload(bucket, object_name, upload_id)
+        return pool.multipart.complete_multipart_upload(
+            bucket, object_name, upload_id, parts)
+
+    def abort_multipart_upload(self, bucket, object_name, upload_id):
+        pool = self._pool_for_upload(bucket, object_name, upload_id)
+        return pool.multipart.abort_multipart_upload(
+            bucket, object_name, upload_id)
+
+    def list_uploads(self, bucket, prefix=""):
+        out = []
+        for pool in self._pools.pools:
+            out.extend(pool.multipart.list_uploads(bucket, prefix))
+        return sorted(out, key=lambda x: (x["object"], x["upload_id"]))
+
+
+class _PoolsHealer:
+    def __init__(self, pools: ErasureServerPools):
+        self._pools = pools
+
+    def heal_object(self, bucket, object_name, dry_run=False):
+        return self._pools._probe(
+            bucket, object_name,
+            lambda p: p.healer.heal_object(bucket, object_name,
+                                           dry_run=dry_run))
+
+    def heal_bucket(self, bucket):
+        out = []
+        for pool in self._pools.pools:
+            out.extend(pool.healer.heal_bucket(bucket))
+        return out
+
+    def heal_all(self):
+        out = []
+        for pool in self._pools.pools:
+            out.extend(pool.healer.heal_all())
+        return out

@@ -1,0 +1,225 @@
+"""Topology tests: SipHash placement, format.json bootstrap, erasure
+sets, server pools (ref cmd/erasure-sets.go, cmd/erasure-server-pool.go,
+cmd/format-erasure.go)."""
+
+import os
+
+import pytest
+
+from minio_tpu.erasure.engine import BucketExists, ObjectNotFound
+from minio_tpu.erasure.pools import ErasureServerPools
+from minio_tpu.erasure.sets import ErasureSets
+from minio_tpu.storage.format import (init_or_load_formats,
+                                      pick_set_layout)
+from minio_tpu.storage.xl import XLStorage
+from minio_tpu.utils.siphash import sip_hash_mod, siphash24
+
+
+def test_siphash_vectors():
+    """Official SipHash-2-4 test vector: key 000102...0f, msg prefixes.
+    First vector (empty msg) = 0x726fdb47dd0e0e31."""
+    key = bytes(range(16))
+    assert siphash24(key, b"") == 0x726FDB47DD0E0E31
+    assert siphash24(key, bytes(range(1))) == 0x74F839C593DC67FD
+    assert siphash24(key, bytes(range(8))) == 0x93F5F5799A932462
+    assert siphash24(key, bytes(range(15))) == 0xA129CA6149BE45E5
+
+
+def test_sip_hash_mod_stable():
+    dep = bytes(16)
+    idx = sip_hash_mod("bucket/obj", 4, dep)
+    assert 0 <= idx < 4
+    assert idx == sip_hash_mod("bucket/obj", 4, dep)
+    assert sip_hash_mod("x", 0, dep) == -1
+
+
+def test_pick_set_layout():
+    assert pick_set_layout(4) == (1, 4)
+    assert pick_set_layout(16) == (1, 16)
+    assert pick_set_layout(32) == (2, 16)
+    assert pick_set_layout(20) == (2, 10)
+    assert pick_set_layout(2) == (1, 2)
+    assert pick_set_layout(12, set_size=4) == (3, 4)
+    with pytest.raises(ValueError):
+        pick_set_layout(12, set_size=5)
+    with pytest.raises(ValueError):
+        pick_set_layout(1)
+
+
+def _mk_disks(tmp_path, n, prefix="d"):
+    return [XLStorage(str(tmp_path / f"{prefix}{i}")) for i in range(n)]
+
+
+def test_format_bootstrap_and_reload(tmp_path):
+    disks = _mk_disks(tmp_path, 8)
+    fmt, ordered, fresh = init_or_load_formats(disks)
+    assert fresh == []
+    assert len(fmt.sets) == 1 and len(fmt.sets[0]) == 8
+    # Reload with shuffled disk order: format restores slot order.
+    shuffled = [disks[i] for i in (3, 1, 7, 0, 5, 2, 6, 4)]
+    fmt2, ordered2, fresh2 = init_or_load_formats(shuffled)
+    assert fmt2.deployment_id == fmt.deployment_id
+    assert [d.root for d in ordered2] == [d.root for d in ordered]
+    assert fresh2 == []
+
+
+def test_format_detects_fresh_disk(tmp_path):
+    import shutil
+    disks = _mk_disks(tmp_path, 4)
+    fmt, ordered, _ = init_or_load_formats(disks)
+    # Wipe disk 2 (replacement).
+    shutil.rmtree(ordered[2].root)
+    os.makedirs(ordered[2].root)
+    fmt2, ordered2, fresh = init_or_load_formats(
+        [XLStorage(d.root) for d in ordered])
+    assert fresh == [2]
+    assert fmt2.deployment_id == fmt.deployment_id
+    # The fresh disk got re-stamped with the slot identity.
+    from minio_tpu.storage.format import load_format
+    f = load_format(ordered2[2])
+    assert f.this == fmt.sets[0][2]
+
+
+def _make_sets(tmp_path, n_disks=8, layout=(4, 4), block_size=8192):
+    disks = _mk_disks(tmp_path, n_disks)
+    fmt, ordered, _ = init_or_load_formats(disks,
+                                           set_size=layout[0])
+    return ErasureSets(ordered, list(layout), fmt.deployment_id,
+                       block_size=block_size)
+
+
+def test_sets_placement_and_roundtrip(tmp_path):
+    sets = _make_sets(tmp_path)
+    sets.make_bucket("b")
+    payloads = {f"obj-{i}": os.urandom(5000 + i) for i in range(20)}
+    for k, v in payloads.items():
+        sets.put_object("b", k, v)
+    # Objects distributed across both sets.
+    indices = {sets.set_index(k) for k in payloads}
+    assert indices == {0, 1}
+    for k, v in payloads.items():
+        got, _ = sets.get_object("b", k)
+        assert got == v
+    # Each object's shards live ONLY in its hashed set.
+    for k in payloads:
+        si = sets.set_index(k)
+        other = sets.sets[1 - si]
+        for d in other.disks:
+            assert not os.path.exists(os.path.join(d.root, "b", k))
+    # Listing merges sets, sorted.
+    names = [o.name for o in sets.list_objects("b")]
+    assert names == sorted(payloads)
+
+
+def test_sets_bucket_fanout(tmp_path):
+    sets = _make_sets(tmp_path)
+    sets.make_bucket("fb")
+    # Bucket exists in every set.
+    for s in sets.sets:
+        assert s.bucket_exists("fb")
+    with pytest.raises(BucketExists):
+        sets.make_bucket("fb")
+    sets.delete_bucket("fb")
+    for s in sets.sets:
+        assert not s.bucket_exists("fb")
+
+
+def test_sets_multipart_dispatch(tmp_path):
+    sets = _make_sets(tmp_path)
+    sets.make_bucket("b")
+    mp = sets.multipart
+    uid = mp.new_multipart_upload("b", "mpobj")
+    data = os.urandom(40_000)
+    p = mp.put_object_part("b", "mpobj", uid, 1, data)
+    mp.complete_multipart_upload("b", "mpobj", uid, [(1, p["etag"])])
+    got, _ = sets.get_object("b", "mpobj")
+    assert got == data
+
+
+def test_pools_placement_and_probe(tmp_path):
+    pool1 = _make_sets(tmp_path / "p1", n_disks=4, layout=(4,))
+    pool2 = _make_sets(tmp_path / "p2", n_disks=4, layout=(4,))
+    pools = ErasureServerPools([pool1, pool2])
+    pools.make_bucket("b")
+    pools.put_object("b", "obj", b"pool data")
+    got, _ = pools.get_object("b", "obj")
+    assert got == b"pool data"
+    # The object lives in exactly one pool; probe finds it regardless.
+    homes = []
+    for i, p in enumerate(pools.pools):
+        try:
+            p.get_object_info("b", "obj")
+            homes.append(i)
+        except ObjectNotFound:
+            pass
+    assert len(homes) == 1
+    # Overwrite goes to the same pool (existing-object affinity).
+    pools.put_object("b", "obj", b"updated")
+    homes2 = []
+    for i, p in enumerate(pools.pools):
+        try:
+            p.get_object_info("b", "obj")
+            homes2.append(i)
+        except ObjectNotFound:
+            pass
+    assert homes2 == homes
+    got, _ = pools.get_object("b", "obj")
+    assert got == b"updated"
+    pools.delete_object("b", "obj")
+    with pytest.raises(ObjectNotFound):
+        pools.get_object("b", "obj")
+
+
+def test_pools_heal_and_list(tmp_path):
+    import shutil
+    pool1 = _make_sets(tmp_path / "p1", n_disks=4, layout=(4,))
+    pool2 = _make_sets(tmp_path / "p2", n_disks=4, layout=(4,))
+    pools = ErasureServerPools([pool1, pool2])
+    pools.make_bucket("b")
+    for i in range(6):
+        pools.put_object("b", f"k{i}", os.urandom(3000))
+    assert [o.name for o in pools.list_objects("b")] == \
+        [f"k{i}" for i in range(6)]
+    # Damage an object living in pool1, heal through the pools facade.
+    victim = None
+    for i in range(6):
+        try:
+            pool1.get_object_info("b", f"k{i}")
+            victim = f"k{i}"
+            break
+        except ObjectNotFound:
+            continue
+    if victim:
+        d = pool1.sets[0].disks[0]
+        shutil.rmtree(os.path.join(d.root, "b", victim),
+                      ignore_errors=True)
+        r = pools.healer.heal_object("b", victim)
+        assert r.healed_disks or r.before_ok == 4
+
+
+def test_cli_builds_pools(tmp_path):
+    from minio_tpu.__main__ import build_object_layer
+    layer = build_object_layer(
+        [str(tmp_path / "a" / "d{1...4}"), str(tmp_path / "b" / "d{1...4}")],
+        block_size=8192)
+    assert len(layer.pools) == 2
+    layer.make_bucket("x")
+    layer.put_object("x", "o", b"data")
+    assert layer.get_object("x", "o")[0] == b"data"
+
+
+def test_foreign_disk_refused(tmp_path):
+    """A disk formatted by another deployment is never re-stamped."""
+    a = _mk_disks(tmp_path / "a", 4)
+    init_or_load_formats(a)
+    b = _mk_disks(tmp_path / "b", 4)
+    init_or_load_formats(b)
+    # Swap one disk of cluster B into cluster A's disk list.
+    mixed = a[:3] + [b[3]]
+    with pytest.raises(ValueError, match="different deployment"):
+        init_or_load_formats([XLStorage(d.root) for d in mixed])
+    # B's disk format untouched.
+    from minio_tpu.storage.format import load_format
+    fb = load_format(b[3])
+    fa = load_format(a[0])
+    assert fb.deployment_id != fa.deployment_id
