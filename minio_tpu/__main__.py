@@ -84,18 +84,39 @@ def build_object_layer(disk_args: list[str],
 def _serve(args) -> int:
     from .s3.server import S3Server
 
-    try:
-        layer = build_object_layer(args.disks, args.block_size)
-    except ValueError as e:
-        print(f"error: {e}", file=sys.stderr)
-        return 1
-
     host, _, port_s = args.address.rpartition(":")
     host = host or "0.0.0.0"
+    port = int(port_s)
     access = os.environ.get("MINIO_ACCESS_KEY", "minioadmin")
     secret = os.environ.get("MINIO_SECRET_KEY", "minioadmin")
-    server = S3Server(layer, access, secret)
-    port = server.start(host, int(port_s))
+
+    distributed = any(a.startswith(("http://", "https://"))
+                      for a in args.disks)
+    try:
+        if distributed:
+            # Start HTTP first (peers need our storage RPC during
+            # format bootstrap; ref serverMain order,
+            # cmd/server-main.go:463).
+            from .rpc.cluster import build_cluster_node, derive_cluster_key
+            from .rpc.transport import RPCRegistry
+            boot_registry = RPCRegistry(
+                derive_cluster_key(access, secret))
+            server = S3Server(None, access, secret,
+                              rpc_registry=boot_registry)
+            port = server.start(host, port)
+            my_host = "127.0.0.1" if host in ("0.0.0.0", "") else host
+            node = build_cluster_node(args.disks, my_host, port,
+                                      access, secret, args.block_size,
+                                      registry=boot_registry)
+            server.set_layer(node.layer)
+            layer = node.layer
+        else:
+            layer = build_object_layer(args.disks, args.block_size)
+            server = S3Server(layer, access, secret)
+            port = server.start(host, port)
+    except (ValueError, TimeoutError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
 
     n_disks = sum(len(s.disks) for p in layer.pools for s in p.sets)
     eng = layer.pools[0].sets[0]

@@ -100,11 +100,15 @@ class ErasureObjects:
         self.m = parity_shards
         self.block_size = block_size
         self.codec = Erasure(data_shards, parity_shards, block_size)
+        from ..parallel.nslock import LocalNSLock
         from .heal import Healer, MRFQueue
         from .multipart import MultipartUploads
         self.healer = Healer(self)
         self.mrf = MRFQueue(self.healer)
         self.multipart = MultipartUploads(self)
+        # Namespace locks: in-process by default; distributed deployments
+        # inject a dsync-backed provider (ref ObjectLayer.NewNSLock).
+        self.ns_lock = LocalNSLock()
 
     # ------------------------------------------------------------------
     # buckets
@@ -232,9 +236,12 @@ class ErasureObjects:
                     pass
                 raise
 
-        _, errs = parallel_map(
-            [lambda i=i: write_one(i) for i in range(n)])
-        reduce_quorum_errs(errs, wq, "put_object")
+        # Exclusive commit (ref NSLock write lock just before the
+        # metadata write + rename, cmd/erasure-object.go:694-700).
+        with self.ns_lock.write_locked(bucket, object_name):
+            _, errs = parallel_map(
+                [lambda i=i: write_one(i) for i in range(n)])
+            reduce_quorum_errs(errs, wq, "put_object")
         if any(e is not None for e in errs):
             # Partial failure feeds the MRF heal queue (ref addPartial,
             # cmd/erasure-object.go:1082).
@@ -330,19 +337,24 @@ class ErasureObjects:
                    length: int = -1, version_id: str = "",
                    ) -> tuple[bytes, ObjectInfo]:
         self._check_bucket(bucket)
-        fi, agreed = self._quorum_file_info(bucket, object_name, version_id)
-        if fi.deleted:
-            raise ObjectNotFound(f"{bucket}/{object_name}")
-        info = ObjectInfo.from_file_info(fi)
-        if offset < 0 or offset > fi.size:
-            raise ValueError("invalid range")
-        if length < 0:
-            length = fi.size - offset
-        if offset + length > fi.size:
-            raise ValueError("invalid range")
-        if length == 0 or fi.size == 0:
-            return b"", info
-        data = self._read_and_decode(fi, agreed, offset, length)
+        # The read lock covers metadata + data so a concurrent overwrite
+        # cannot swap the data dir between the two reads (ref read lock
+        # around GetObjectNInfo, cmd/erasure-object.go:134).
+        with self.ns_lock.read_locked(bucket, object_name):
+            fi, agreed = self._quorum_file_info(bucket, object_name,
+                                                version_id)
+            if fi.deleted:
+                raise ObjectNotFound(f"{bucket}/{object_name}")
+            info = ObjectInfo.from_file_info(fi)
+            if offset < 0 or offset > fi.size:
+                raise ValueError("invalid range")
+            if length < 0:
+                length = fi.size - offset
+            if offset + length > fi.size:
+                raise ValueError("invalid range")
+            if length == 0 or fi.size == 0:
+                return b"", info
+            data = self._read_and_decode(fi, agreed, offset, length)
         return data, info
 
     def _shard_readers(self, fi: FileInfo,
@@ -500,9 +512,10 @@ class ErasureObjects:
         self._check_bucket(bucket)
         fi = FileInfo(volume=bucket, name=object_name,
                       version_id=version_id)
-        _, errs = parallel_map(
-            [lambda d=d: d.delete_version(bucket, object_name, fi)
-             for d in self.disks])
+        with self.ns_lock.write_locked(bucket, object_name):
+            _, errs = parallel_map(
+                [lambda d=d: d.delete_version(bucket, object_name, fi)
+                 for d in self.disks])
         not_found = sum(1 for e in errs if isinstance(
             e, (serr.FileNotFound, serr.VersionNotFound)))
         if not_found == len(self.disks):

@@ -278,9 +278,13 @@ class MultipartUploads:
                     pass
                 raise
 
-        _, errs = parallel_map(
-            [lambda i=i: commit_one(i) for i in range(len(eng.disks))])
-        reduce_quorum_errs(errs, wq, "complete_multipart_upload")
+        # Exclusive commit against concurrent put/delete on the same key
+        # (ref CompleteMultipartUpload NSLock, cmd/erasure-multipart.go).
+        with eng.ns_lock.write_locked(bucket, object_name):
+            _, errs = parallel_map(
+                [lambda i=i: commit_one(i)
+                 for i in range(len(eng.disks))])
+            reduce_quorum_errs(errs, wq, "complete_multipart_upload")
         if any(e is not None for e in errs):
             eng.mrf.add(bucket, object_name)
         self._cleanup(bucket, object_name, upload_id)

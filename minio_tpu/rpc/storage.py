@@ -1,0 +1,220 @@
+"""Storage RPC: every StorageAPI method over the wire, so a peer node's
+disks join an erasure set exactly like local ones (ref
+cmd/storage-rest-server.go route table :1025-1075, storage-rest-client).
+
+StorageRPCService exposes a node's LOCAL disks (indexed by their path);
+RemoteStorage implements StorageAPI against a peer's service.
+"""
+
+from __future__ import annotations
+
+import base64
+
+from ..storage import errors as serr
+from ..storage.interface import StorageAPI
+from ..storage.metadata import FileInfo
+from .transport import RPCClient
+
+
+def _fi_to_wire(fi: FileInfo) -> dict:
+    d = fi.to_version_dict()
+    d["_volume"] = fi.volume
+    d["_name"] = fi.name
+    return d
+
+
+def _fi_from_wire(d: dict) -> FileInfo:
+    fi = FileInfo.from_version_dict(d.get("_volume", ""),
+                                    d.get("_name", ""), d)
+    return fi
+
+
+class StorageRPCService:
+    """Server side: dispatches to this node's local disks by disk path."""
+
+    def __init__(self, local_disks: dict[str, StorageAPI]):
+        self.disks = local_disks
+
+    def _disk(self, args: dict) -> StorageAPI:
+        d = self.disks.get(args["disk"])
+        if d is None:
+            raise serr.DiskNotFound(args.get("disk", "?"))
+        return d
+
+    # Each rpc_* takes (args, payload) -> (result, body).
+
+    def rpc_disk_info(self, a, p):
+        return self._disk(a).disk_info(), b""
+
+    def rpc_make_volume(self, a, p):
+        self._disk(a).make_volume(a["volume"])
+        return {}, b""
+
+    def rpc_list_volumes(self, a, p):
+        return {"volumes": self._disk(a).list_volumes()}, b""
+
+    def rpc_stat_volume(self, a, p):
+        return self._disk(a).stat_volume(a["volume"]), b""
+
+    def rpc_delete_volume(self, a, p):
+        self._disk(a).delete_volume(a["volume"], a.get("force", False))
+        return {}, b""
+
+    def rpc_write_all(self, a, p):
+        self._disk(a).write_all(a["volume"], a["path"], p)
+        return {}, b""
+
+    def rpc_read_all(self, a, p):
+        return {}, self._disk(a).read_all(a["volume"], a["path"])
+
+    def rpc_read_file(self, a, p):
+        return {}, self._disk(a).read_file(a["volume"], a["path"],
+                                           a["offset"], a["length"])
+
+    def rpc_create_file(self, a, p):
+        self._disk(a).create_file(a["volume"], a["path"], p)
+        return {}, b""
+
+    def rpc_delete(self, a, p):
+        self._disk(a).delete(a["volume"], a["path"],
+                             a.get("recursive", False))
+        return {}, b""
+
+    def rpc_rename_file(self, a, p):
+        self._disk(a).rename_file(a["src_volume"], a["src_path"],
+                                  a["dst_volume"], a["dst_path"])
+        return {}, b""
+
+    def rpc_list_dir(self, a, p):
+        return {"entries": self._disk(a).list_dir(a["volume"],
+                                                  a["path"])}, b""
+
+    def rpc_rename_data(self, a, p):
+        self._disk(a).rename_data(a["src_volume"], a["src_path"],
+                                  _fi_from_wire(a["fi"]),
+                                  a["dst_volume"], a["dst_path"])
+        return {}, b""
+
+    def rpc_write_metadata(self, a, p):
+        self._disk(a).write_metadata(a["volume"], a["path"],
+                                     _fi_from_wire(a["fi"]))
+        return {}, b""
+
+    def rpc_read_version(self, a, p):
+        fi = self._disk(a).read_version(a["volume"], a["path"],
+                                        a.get("version_id", ""))
+        return {"fi": _fi_to_wire(fi)}, b""
+
+    def rpc_delete_version(self, a, p):
+        self._disk(a).delete_version(a["volume"], a["path"],
+                                     _fi_from_wire(a["fi"]))
+        return {}, b""
+
+    def rpc_read_parts(self, a, p):
+        return {"parts": self._disk(a).read_parts(
+            a["volume"], a["path"], a["data_dir"])}, b""
+
+    def rpc_verify_file(self, a, p):
+        self._disk(a).verify_file(a["volume"], a["path"],
+                                  _fi_from_wire(a["fi"]))
+        return {}, b""
+
+
+class RemoteStorage(StorageAPI):
+    """StorageAPI over the wire: one peer disk (ref storageRESTClient,
+    cmd/storage-rest-client.go)."""
+
+    def __init__(self, client: RPCClient, disk_path: str):
+        self.client = client
+        self.disk_path = disk_path
+
+    def __repr__(self) -> str:
+        return f"RemoteStorage({self.client.endpoint()}{self.disk_path})"
+
+    def _call(self, method: str, args: dict | None = None,
+              payload: bytes = b"") -> tuple[dict, bytes]:
+        a = {"disk": self.disk_path}
+        a.update(args or {})
+        return self.client.call("storage", method, a, payload)
+
+    def endpoint(self) -> str:
+        return f"{self.client.endpoint()}{self.disk_path}"
+
+    def is_online(self) -> bool:
+        return self.client.is_online()
+
+    def disk_info(self) -> dict:
+        return self._call("disk_info")[0]
+
+    def make_volume(self, volume):
+        self._call("make_volume", {"volume": volume})
+
+    def list_volumes(self):
+        return self._call("list_volumes")[0]["volumes"]
+
+    def stat_volume(self, volume):
+        return self._call("stat_volume", {"volume": volume})[0]
+
+    def delete_volume(self, volume, force=False):
+        self._call("delete_volume", {"volume": volume, "force": force})
+
+    def write_all(self, volume, path, data):
+        self._call("write_all", {"volume": volume, "path": path},
+                   bytes(data))
+
+    def read_all(self, volume, path):
+        return self._call("read_all", {"volume": volume,
+                                       "path": path})[1]
+
+    def read_file(self, volume, path, offset, length):
+        return self._call("read_file", {"volume": volume, "path": path,
+                                        "offset": offset,
+                                        "length": length})[1]
+
+    def create_file(self, volume, path, data):
+        self._call("create_file", {"volume": volume, "path": path},
+                   bytes(data))
+
+    def delete(self, volume, path, recursive=False):
+        self._call("delete", {"volume": volume, "path": path,
+                              "recursive": recursive})
+
+    def rename_file(self, src_volume, src_path, dst_volume, dst_path):
+        self._call("rename_file", {"src_volume": src_volume,
+                                   "src_path": src_path,
+                                   "dst_volume": dst_volume,
+                                   "dst_path": dst_path})
+
+    def list_dir(self, volume, path):
+        return self._call("list_dir", {"volume": volume,
+                                       "path": path})[0]["entries"]
+
+    def rename_data(self, src_volume, src_path, fi, dst_volume, dst_path):
+        self._call("rename_data", {"src_volume": src_volume,
+                                   "src_path": src_path,
+                                   "fi": _fi_to_wire(fi),
+                                   "dst_volume": dst_volume,
+                                   "dst_path": dst_path})
+
+    def write_metadata(self, volume, path, fi):
+        self._call("write_metadata", {"volume": volume, "path": path,
+                                      "fi": _fi_to_wire(fi)})
+
+    def read_version(self, volume, path, version_id=""):
+        res, _ = self._call("read_version", {"volume": volume,
+                                             "path": path,
+                                             "version_id": version_id})
+        return _fi_from_wire(res["fi"])
+
+    def delete_version(self, volume, path, fi):
+        self._call("delete_version", {"volume": volume, "path": path,
+                                      "fi": _fi_to_wire(fi)})
+
+    def read_parts(self, volume, path, data_dir):
+        return self._call("read_parts", {"volume": volume, "path": path,
+                                         "data_dir": data_dir,
+                                         })[0]["parts"]
+
+    def verify_file(self, volume, path, fi):
+        self._call("verify_file", {"volume": volume, "path": path,
+                                   "fi": _fi_to_wire(fi)})

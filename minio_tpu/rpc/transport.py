@@ -1,0 +1,213 @@
+"""Internal RPC transport: authenticated POST with length-prefixed JSON +
+binary framing, pooled keep-alive connections, and health-gated clients
+with reconnect (ref cmd/rest/client.go:62,193 MarkOffline +
+HealthCheckFn).
+
+Wire format per call (everything in the BODY — headers stay tiny):
+    POST /minio-tpu/rpc/v1/<service>/<method>
+    x-mtpu-auth: hex hmac-sha256(cluster_key,
+                   service/method + "\\n" + ts + "\\n" + args_json
+                   + "\\n" + sha256(payload))
+    x-mtpu-ts:   unix seconds (rejected outside +/- 5 min skew window;
+                 bounds replay — cluster ports are expected to run on a
+                 trusted network like the reference's)
+    body: [4B big-endian args_len][args_json][payload]
+Response 200: [4B result_len][result_json][body]; errors are 4xx/5xx with
+a JSON {error_type, message} mapped back to storage errors.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import http.client
+import json
+import struct
+import threading
+import time
+
+from ..storage import errors as serr
+
+RPC_PREFIX = "/minio-tpu/rpc/v1"
+MAX_SKEW = 300  # seconds
+
+_ERR_TYPES = {
+    "DiskNotFound": serr.DiskNotFound,
+    "FaultyDisk": serr.FaultyDisk,
+    "VolumeNotFound": serr.VolumeNotFound,
+    "VolumeExists": serr.VolumeExists,
+    "FileNotFound": serr.FileNotFound,
+    "VersionNotFound": serr.VersionNotFound,
+    "FileCorrupt": serr.FileCorrupt,
+    "DiskFull": serr.DiskFull,
+}
+
+
+def sign(cluster_key: bytes, method: str, ts: str, args_json: str,
+         payload: bytes) -> str:
+    msg = "\n".join([method, ts, args_json,
+                     hashlib.sha256(payload).hexdigest()])
+    return hmac.new(cluster_key, msg.encode(), hashlib.sha256).hexdigest()
+
+
+def frame(args_json: bytes, payload: bytes) -> bytes:
+    return struct.pack(">I", len(args_json)) + args_json + payload
+
+
+def unframe(body: bytes) -> tuple[bytes, bytes]:
+    if len(body) < 4:
+        raise ValueError("short rpc frame")
+    n = struct.unpack(">I", body[:4])[0]
+    if len(body) < 4 + n:
+        raise ValueError("truncated rpc frame")
+    return body[4:4 + n], body[4 + n:]
+
+
+def error_to_wire(e: BaseException) -> tuple[int, bytes]:
+    name = type(e).__name__
+    status = 404 if isinstance(e, (serr.FileNotFound, serr.VolumeNotFound,
+                                   serr.VersionNotFound)) else 500
+    return status, json.dumps({"error_type": name,
+                               "message": str(e)}).encode()
+
+
+def wire_to_error(status: int, body: bytes) -> Exception:
+    try:
+        doc = json.loads(body)
+        cls = _ERR_TYPES.get(doc.get("error_type"), serr.FaultyDisk)
+        return cls(doc.get("message", f"rpc status {status}"))
+    except (ValueError, KeyError):
+        return serr.FaultyDisk(f"rpc status {status}: {body[:200]!r}")
+
+
+class RPCClient:
+    """Health-gated RPC caller to one peer, with a pooled keep-alive
+    connection."""
+
+    # Seconds a peer stays marked offline before a reconnect probe.
+    OFFLINE_RETRY = 2.0
+
+    def __init__(self, host: str, port: int, cluster_key: bytes,
+                 timeout: float = 30.0):
+        self.host = host
+        self.port = port
+        self.cluster_key = cluster_key
+        self.timeout = timeout
+        self._offline_until = 0.0
+        self._mu = threading.Lock()
+        self._pool: list[http.client.HTTPConnection] = []
+
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def is_online(self) -> bool:
+        return time.monotonic() >= self._offline_until
+
+    def _mark_offline(self) -> None:
+        with self._mu:
+            self._offline_until = time.monotonic() + self.OFFLINE_RETRY
+
+    def _get_conn(self) -> http.client.HTTPConnection:
+        with self._mu:
+            if self._pool:
+                return self._pool.pop()
+        return http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout)
+
+    def _put_conn(self, conn: http.client.HTTPConnection) -> None:
+        with self._mu:
+            if len(self._pool) < 8:
+                self._pool.append(conn)
+                return
+        conn.close()
+
+    def call(self, service: str, method: str, args: dict,
+             payload: bytes = b"") -> tuple[dict, bytes]:
+        """Returns (result_json, body_bytes); raises storage errors."""
+        if not self.is_online():
+            raise serr.DiskNotFound(f"{self.endpoint()} offline")
+        args_json = json.dumps(args, sort_keys=True)
+        ts = str(int(time.time()))
+        body = frame(args_json.encode(), payload)
+        headers = {
+            "x-mtpu-ts": ts,
+            "x-mtpu-auth": sign(self.cluster_key, f"{service}/{method}",
+                                ts, args_json, payload),
+            "Content-Length": str(len(body)),
+        }
+        conn = self._get_conn()
+        try:
+            conn.request("POST", f"{RPC_PREFIX}/{service}/{method}",
+                         body=body, headers=headers)
+            resp = conn.getresponse()
+            rbody = resp.read()
+            if resp.status != 200:
+                self._put_conn(conn)
+                raise wire_to_error(resp.status, rbody)
+            result_json, data = unframe(rbody)
+            self._put_conn(conn)
+            return json.loads(result_json or b"{}"), data
+        except (OSError, http.client.HTTPException, ValueError) as e:
+            conn.close()
+            self._mark_offline()
+            raise serr.DiskNotFound(
+                f"{self.endpoint()} unreachable: {e}")
+
+    def close(self) -> None:
+        with self._mu:
+            for c in self._pool:
+                c.close()
+            self._pool.clear()
+
+
+class RPCRegistry:
+    """Server side: named services exposing methods.
+
+    A service is an object; exposed methods take (args: dict,
+    payload: bytes) and return (result: dict, body: bytes).
+    """
+
+    def __init__(self, cluster_key: bytes):
+        self.cluster_key = cluster_key
+        self._services: dict[str, object] = {}
+
+    def register(self, name: str, service: object) -> None:
+        self._services[name] = service
+
+    def handle(self, path: str, headers: dict[str, str],
+               body: bytes) -> tuple[int, dict[str, str], bytes]:
+        """Dispatch an RPC HTTP request; returns (status, headers, body)."""
+        if not path.startswith(RPC_PREFIX + "/"):
+            return 404, {}, b"not found"
+        rest = path[len(RPC_PREFIX) + 1:]
+        if "/" not in rest:
+            return 404, {}, b"bad rpc path"
+        service_name, method = rest.split("/", 1)
+        try:
+            args_bytes, payload = unframe(body)
+        except ValueError:
+            return 400, {}, b"bad rpc frame"
+        ts = headers.get("x-mtpu-ts", "")
+        try:
+            if abs(time.time() - int(ts)) > MAX_SKEW:
+                return 403, {}, b"rpc timestamp out of window"
+        except ValueError:
+            return 403, {}, b"bad rpc timestamp"
+        args_json = args_bytes.decode("utf-8", "replace")
+        want = sign(self.cluster_key, f"{service_name}/{method}", ts,
+                    args_json, payload)
+        if not hmac.compare_digest(want,
+                                   headers.get("x-mtpu-auth", "")):
+            return 403, {}, b"bad rpc signature"
+        service = self._services.get(service_name)
+        fn = getattr(service, f"rpc_{method}", None) if service else None
+        if fn is None:
+            return 404, {}, f"no method {service_name}/{method}".encode()
+        try:
+            args = json.loads(args_json)
+            result, rbody = fn(args, payload)
+            out = frame(json.dumps(result).encode(), rbody)
+            return 200, {}, out
+        except BaseException as e:  # noqa: BLE001 — serialized to peer
+            status, ebody = error_to_wire(e)
+            return status, {}, ebody

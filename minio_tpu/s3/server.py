@@ -485,14 +485,27 @@ class S3Server:
     """HTTP front end with SigV4 auth (the reference's generic-handlers
     auth dispatch, ref cmd/auth-handler.go)."""
 
-    def __init__(self, layer: ErasureObjects, access_key: str = "minioadmin",
-                 secret_key: str = "minioadmin", region: str = "us-east-1"):
-        self.handlers = S3ApiHandlers(layer, region)
+    def __init__(self, layer: ErasureObjects | None = None,
+                 access_key: str = "minioadmin",
+                 secret_key: str = "minioadmin", region: str = "us-east-1",
+                 rpc_registry=None):
+        self.handlers = S3ApiHandlers(layer, region) if layer else None
         self.access_key = access_key
         self.secret_key = secret_key
         self.region = region
+        self.rpc_registry = rpc_registry
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
+
+    @property
+    def layer(self):
+        return self.handlers.layer if self.handlers else None
+
+    def set_layer(self, layer) -> None:
+        """Attach the object layer once boot completes (the reference
+        serves 503 until newObjectLayer finishes,
+        cmd/server-main.go:463)."""
+        self.handlers = S3ApiHandlers(layer, self.region)
 
     def _lookup_secret(self, access_key: str) -> str | None:
         return self.secret_key if access_key == self.access_key else None
@@ -510,6 +523,8 @@ class S3Server:
 
     def route(self, req: S3Request) -> S3Response:
         h = self.handlers
+        if h is None:
+            raise s3err.ERR_SLOW_DOWN  # 503 until the layer is ready
         self.authenticate(req)
         m, bucket, key, p = req.method, req.bucket, req.key, req.params
         if not bucket:
@@ -569,6 +584,20 @@ class S3Server:
                     body = self.rfile.read(length) if length else b""
                     raw_path, _, query = self.path.partition("?")
                     headers = {k.lower(): v for k, v in self.headers.items()}
+                    # Internal cluster RPC rides the same port
+                    # (ref registerDistErasureRouters, cmd/routers.go:26).
+                    if server.rpc_registry is not None and \
+                            raw_path.startswith("/minio-tpu/rpc/"):
+                        status, rhdrs, rbody = server.rpc_registry.handle(
+                            raw_path, headers, body)
+                        self.send_response(status)
+                        for k, v in rhdrs.items():
+                            self.send_header(k, v)
+                        self.send_header("Content-Length", str(len(rbody)))
+                        self.end_headers()
+                        if rbody:
+                            self.wfile.write(rbody)
+                        return
                     req = S3Request(self.command, raw_path, query, headers,
                                     body)
                     try:
