@@ -81,6 +81,14 @@ def build_object_layer(disk_args: list[str],
     return layer
 
 
+def _make_iam(layer, access: str, secret: str):
+    """IAM persisted on the store's own first erasure set
+    (ref iam-object-store in .minio.sys)."""
+    from .iam.iam import ConfigStore, IAMSys
+    disks = layer.pools[0].sets[0].disks
+    return IAMSys(ConfigStore(disks), access, secret)
+
+
 def _serve(args) -> int:
     from .s3.server import S3Server
 
@@ -109,10 +117,12 @@ def _serve(args) -> int:
                                       access, secret, args.block_size,
                                       registry=boot_registry)
             server.set_layer(node.layer)
+            server.iam = _make_iam(node.layer, access, secret)
             layer = node.layer
         else:
             layer = build_object_layer(args.disks, args.block_size)
-            server = S3Server(layer, access, secret)
+            server = S3Server(layer, access, secret,
+                              iam=_make_iam(layer, access, secret))
             port = server.start(host, port)
     except (ValueError, TimeoutError) as e:
         print(f"error: {e}", file=sys.stderr)

@@ -1,0 +1,3 @@
+"""Identity & access: users, groups, canned policies, AWS-compatible
+policy evaluation, STS temporary credentials (ref cmd/iam.go:204 IAMSys,
+pkg/iam/policy, cmd/sts-handlers.go)."""

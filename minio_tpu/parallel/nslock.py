@@ -60,48 +60,59 @@ class _RWLock:
 
 class LocalNSLock:
     """In-process namespace lock registry (ref nsLockMap,
-    cmd/namespace-lock.go)."""
+    cmd/namespace-lock.go). Entries are reference-counted so a lock
+    object handed to a waiter is never GC'd out from under it (the
+    ref/waiter count is the reference's nsLock ref counter)."""
 
     def __init__(self):
         self._mu = threading.Lock()
-        self._locks: dict[tuple[str, str], _RWLock] = {}
+        # key -> [lock, refcount]
+        self._locks: dict[tuple[str, str], list] = {}
 
     def _get(self, bucket: str, obj: str) -> _RWLock:
         with self._mu:
             key = (bucket, obj)
-            lk = self._locks.get(key)
-            if lk is None:
-                lk = _RWLock()
-                self._locks[key] = lk
-            return lk
+            ent = self._locks.get(key)
+            if ent is None:
+                ent = [_RWLock(), 0]
+                self._locks[key] = ent
+            ent[1] += 1
+            return ent[0]
 
-    def _gc(self, bucket: str, obj: str) -> None:
+    def _put(self, bucket: str, obj: str) -> None:
         with self._mu:
             key = (bucket, obj)
-            lk = self._locks.get(key)
-            if lk is not None and lk.idle():
+            ent = self._locks.get(key)
+            if ent is None:
+                return
+            ent[1] -= 1
+            if ent[1] <= 0 and ent[0].idle():
                 del self._locks[key]
 
     @contextmanager
     def write_locked(self, bucket: str, obj: str,
                      timeout: float | None = 30.0):
         lk = self._get(bucket, obj)
-        if not lk.acquire_write(timeout):
-            raise TimeoutError(f"write lock timeout: {bucket}/{obj}")
         try:
-            yield
+            if not lk.acquire_write(timeout):
+                raise TimeoutError(f"write lock timeout: {bucket}/{obj}")
+            try:
+                yield
+            finally:
+                lk.release_write()
         finally:
-            lk.release_write()
-            self._gc(bucket, obj)
+            self._put(bucket, obj)
 
     @contextmanager
     def read_locked(self, bucket: str, obj: str,
                     timeout: float | None = 30.0):
         lk = self._get(bucket, obj)
-        if not lk.acquire_read(timeout):
-            raise TimeoutError(f"read lock timeout: {bucket}/{obj}")
         try:
-            yield
+            if not lk.acquire_read(timeout):
+                raise TimeoutError(f"read lock timeout: {bucket}/{obj}")
+            try:
+                yield
+            finally:
+                lk.release_read()
         finally:
-            lk.release_read()
-            self._gc(bucket, obj)
+            self._put(bucket, obj)

@@ -44,23 +44,31 @@ class LocalLocker:
 
     def lock(self, resource: str, uid: str, writer: bool) -> bool:
         """Acquire or refresh: a repeat call from the holding uid renews
-        the TTL (the keep-alive path)."""
+        the TTL (the keep-alive path). A failed writer attempt leaves a
+        short writer-preference window during which new readers are
+        refused, so steady reads can't starve writes."""
         now = time.monotonic()
         with self._mu:
             self._sweep(now)
             st = self._locks.setdefault(
-                resource, {"writer": None, "readers": {}, "expiry": 0.0})
+                resource, {"writer": None, "readers": {}, "expiry": 0.0,
+                           "writer_wait": 0.0})
             if writer:
                 if st["writer"] is None and not st["readers"]:
                     st["writer"] = uid
                     st["expiry"] = now + LOCK_TTL
+                    st["writer_wait"] = 0.0
                     return True
                 if st["writer"] == uid:
                     st["expiry"] = now + LOCK_TTL
                     return True
+                st["writer_wait"] = now + 1.0
                 return False
-            if st["writer"] is None:
+            if st["writer"] is None and st.get("writer_wait", 0.0) <= now:
                 st["readers"][uid] = now + LOCK_TTL
+                return True
+            if st["writer"] is None and uid in st["readers"]:
+                st["readers"][uid] = now + LOCK_TTL  # refresh held read
                 return True
             return False
 
@@ -191,9 +199,12 @@ class DRWMutex:
                     f"dsync: could not acquire {self.resource}")
             time.sleep(random.uniform(0.01, 0.05))
 
-    def refresh(self, uid: str, writer: bool) -> None:
-        """Keep-alive: re-lock on every locker renews the server TTL."""
-        self._fan("lock", uid, writer)
+    def refresh(self, uid: str, writer: bool) -> bool:
+        """Keep-alive: re-lock on every locker renews the server TTL.
+        Returns False when the quorum was LOST (swept/usurped during a
+        partition) — the holder no longer has exclusion."""
+        grants = self._fan("lock", uid, writer)
+        return sum(grants) >= self._quorum(writer)
 
     def release(self, uid: str, writer: bool) -> None:
         self._fan("unlock", uid, writer)
@@ -207,6 +218,27 @@ class DistNSLock:
     def __init__(self, lockers: list, default_timeout: float = 30.0):
         self.lockers = lockers
         self.default_timeout = default_timeout
+        # One shared keep-alive sweeper refreshes every held lock
+        # (ref drwmutex continuous refresh; avoids a thread per lock).
+        self._mu = threading.Lock()
+        self._held: dict[int, dict] = {}
+        self._next_id = 0
+        self._sweeper: threading.Thread | None = None
+
+    def _ensure_sweeper(self) -> None:
+        if self._sweeper is None or not self._sweeper.is_alive():
+            self._sweeper = threading.Thread(target=self._sweep_loop,
+                                             daemon=True)
+            self._sweeper.start()
+
+    def _sweep_loop(self) -> None:
+        while True:
+            time.sleep(LOCK_TTL / 3)
+            with self._mu:
+                entries = list(self._held.values())
+            for e in entries:
+                if not e["mutex"].refresh(e["uid"], e["writer"]):
+                    e["lost"] = True
 
     @contextmanager
     def _locked(self, bucket: str, obj: str, writer: bool,
@@ -214,20 +246,24 @@ class DistNSLock:
         m = DRWMutex(self.lockers, f"{bucket}/{obj}")
         uid = m.acquire(writer=writer,
                         timeout=timeout or self.default_timeout)
-        # Keep-alive refresher so held locks outlive LOCK_TTL
-        # (ref drwmutex continuous refresh loop).
-        stop = threading.Event()
-
-        def refresher():
-            while not stop.wait(LOCK_TTL / 3):
-                m.refresh(uid, writer)
-
-        t = threading.Thread(target=refresher, daemon=True)
-        t.start()
+        entry = {"mutex": m, "uid": uid, "writer": writer, "lost": False}
+        with self._mu:
+            hid = self._next_id
+            self._next_id += 1
+            self._held[hid] = entry
+        self._ensure_sweeper()
         try:
             yield
+            if entry["lost"]:
+                # Exclusion was lost mid-operation (partition longer
+                # than LOCK_TTL): surface it loudly instead of
+                # pretending the op was safe.
+                raise TimeoutError(
+                    f"dsync: lock on {bucket}/{obj} lost during "
+                    f"operation (possible concurrent writer)")
         finally:
-            stop.set()
+            with self._mu:
+                self._held.pop(hid, None)
             m.release(uid, writer=writer)
 
     def write_locked(self, bucket: str, obj: str,

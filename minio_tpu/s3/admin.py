@@ -1,0 +1,242 @@
+"""Admin API + health checks + Prometheus metrics
+(ref cmd/admin-router.go, cmd/admin-handlers.go, cmd/healthcheck-router.go,
+cmd/metrics-v2.go).
+
+Routes (same port as S3, non-S3 prefixes):
+    /minio-tpu/admin/v1/...    root-credential SigV4 JSON API
+    /minio-tpu/health/live     liveness (200 always once HTTP is up)
+    /minio-tpu/health/ready    readiness (object layer attached)
+    /minio-tpu/health/cluster  quorum-aware (every set readable)
+    /minio-tpu/metrics         Prometheus text exposition
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from .. import __version__
+
+
+class Metrics:
+    """Request/error/byte counters (ref cmd/http-stats.go,
+    metrics-v2 collectors)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.start_time = time.time()
+        self.requests: dict[str, int] = {}
+        self.errors: dict[str, int] = {}
+        self.rx_bytes = 0
+        self.tx_bytes = 0
+
+    def record(self, api: str, status: int, rx: int, tx: int) -> None:
+        with self._mu:
+            self.requests[api] = self.requests.get(api, 0) + 1
+            if status >= 400:
+                key = f"{api}:{status}"
+                self.errors[key] = self.errors.get(key, 0) + 1
+            self.rx_bytes += rx
+            self.tx_bytes += tx
+
+    def prometheus(self, layer) -> str:
+        lines = [
+            "# HELP minio_tpu_uptime_seconds Server uptime.",
+            "# TYPE minio_tpu_uptime_seconds gauge",
+            f"minio_tpu_uptime_seconds "
+            f"{time.time() - self.start_time:.1f}",
+            "# TYPE minio_tpu_rx_bytes_total counter",
+            f"minio_tpu_rx_bytes_total {self.rx_bytes}",
+            "# TYPE minio_tpu_tx_bytes_total counter",
+            f"minio_tpu_tx_bytes_total {self.tx_bytes}",
+            "# TYPE minio_tpu_requests_total counter",
+        ]
+        with self._mu:
+            for api, n in sorted(self.requests.items()):
+                lines.append(
+                    f'minio_tpu_requests_total{{api="{api}"}} {n}')
+            lines.append("# TYPE minio_tpu_errors_total counter")
+            for key, n in sorted(self.errors.items()):
+                api, _, status = key.rpartition(":")
+                lines.append(
+                    f'minio_tpu_errors_total{{api="{api}",'
+                    f'status="{status}"}} {n}')
+        if layer is not None:
+            lines.append("# TYPE minio_tpu_disk_online gauge")
+            lines.append("# TYPE minio_tpu_disk_total_bytes gauge")
+            lines.append("# TYPE minio_tpu_disk_free_bytes gauge")
+            for p_i, pool in enumerate(_pools(layer)):
+                for s_i, es in enumerate(pool.sets):
+                    for d_i, disk in enumerate(es.disks):
+                        lbl = (f'pool="{p_i}",set="{s_i}",'
+                               f'disk="{d_i}"')
+                        try:
+                            info = disk.disk_info()
+                            lines.append(
+                                f"minio_tpu_disk_online{{{lbl}}} 1")
+                            lines.append(
+                                f"minio_tpu_disk_total_bytes{{{lbl}}} "
+                                f"{info.get('total', 0)}")
+                            lines.append(
+                                f"minio_tpu_disk_free_bytes{{{lbl}}} "
+                                f"{info.get('free', 0)}")
+                        except Exception:
+                            lines.append(
+                                f"minio_tpu_disk_online{{{lbl}}} 0")
+        return "\n".join(lines) + "\n"
+
+
+def _pools(layer):
+    if hasattr(layer, "pools"):
+        return layer.pools
+    if hasattr(layer, "sets"):
+        class _P:
+            sets = layer.sets
+        return [_P]
+    class _S:
+        sets = [layer]
+    return [_S]
+
+
+class AdminHandlers:
+    """JSON admin API over the object layer + IAM (root only)."""
+
+    def __init__(self, server):
+        self.server = server  # S3Server
+
+    def handle(self, method: str, path: str, params: dict,
+               body: bytes, access_key: str) -> tuple[int, bytes]:
+        if access_key != self.server.access_key:
+            return 403, json.dumps({"error": "admin requires root"
+                                    }).encode()
+        route = path.removeprefix("/minio-tpu/admin/v1/")
+        fn = getattr(self, f"h_{route.replace('-', '_')}", None)
+        if fn is None:
+            return 404, json.dumps({"error": f"unknown: {route}"}).encode()
+        try:
+            out = fn(params, body)
+            return 200, json.dumps(out, default=str).encode()
+        except KeyError as e:
+            return 404, json.dumps({"error": f"not found: {e}"}).encode()
+        except (ValueError, TypeError) as e:
+            return 400, json.dumps({"error": str(e)}).encode()
+
+    # -- info / usage ---------------------------------------------------
+
+    def h_info(self, p, body):
+        layer = self.server.layer
+        pools = []
+        for pool in _pools(layer):
+            sets = []
+            for es in pool.sets:
+                online = 0
+                total = free = 0
+                for d in es.disks:
+                    try:
+                        info = d.disk_info()
+                        online += 1
+                        total += info.get("total", 0)
+                        free += info.get("free", 0)
+                    except Exception:
+                        pass
+                sets.append({"disks": len(es.disks), "online": online,
+                             "data": es.k, "parity": es.m,
+                             "totalBytes": total, "freeBytes": free})
+            pools.append({"sets": sets})
+        return {"version": __version__, "mode": "erasure",
+                "pools": pools,
+                "uptime": time.time() - self.server.metrics.start_time}
+
+    def h_datausage(self, p, body):
+        layer = self.server.layer
+        usage: dict[str, dict] = {}
+        for b in layer.list_buckets():
+            objs = layer.list_objects(b["name"], max_keys=1_000_000)
+            usage[b["name"]] = {
+                "objects": len(objs),
+                "size": sum(o.size for o in objs),
+            }
+        return {"buckets": usage}
+
+    # -- users / policies ----------------------------------------------
+
+    def _iam(self):
+        if self.server.iam is None:
+            raise ValueError("IAM not configured")
+        return self.server.iam
+
+    def h_add_user(self, p, body):
+        doc = json.loads(body)
+        self._iam().add_user(doc["accessKey"], doc["secretKey"],
+                             doc.get("policies", []))
+        return {"ok": True}
+
+    def h_list_users(self, p, body):
+        return {"users": self._iam().list_users()}
+
+    def h_remove_user(self, p, body):
+        self._iam().remove_user(p["accessKey"])
+        return {"ok": True}
+
+    def h_set_user_policy(self, p, body):
+        self._iam().set_user_policy(p["accessKey"],
+                                    p["policies"].split(","))
+        return {"ok": True}
+
+    def h_add_policy(self, p, body):
+        self._iam().set_policy(p["name"], json.loads(body))
+        return {"ok": True}
+
+    def h_list_policies(self, p, body):
+        return {"policies": self._iam().list_policies()}
+
+    def h_remove_policy(self, p, body):
+        self._iam().delete_policy(p["name"])
+        return {"ok": True}
+
+    def h_add_group(self, p, body):
+        doc = json.loads(body)
+        self._iam().add_group(doc["group"], doc.get("members", []),
+                              doc.get("policies"))
+        return {"ok": True}
+
+    # -- heal -----------------------------------------------------------
+
+    def h_heal(self, p, body):
+        layer = self.server.layer
+        bucket = p.get("bucket", "")
+        prefix = p.get("prefix", "")
+        dry = p.get("dryRun") == "true"
+        results = []
+        if bucket:
+            layer.healer.heal_bucket(bucket)
+            objs = ([o for o in layer.list_objects(
+                bucket, prefix=prefix, max_keys=100_000)])
+            for o in objs:
+                r = layer.healer.heal_object(bucket, o.name,
+                                             dry_run=dry)
+                results.append({
+                    "object": o.name, "beforeOk": r.before_ok,
+                    "afterOk": r.after_ok,
+                    "healedDisks": r.healed_disks,
+                    "dangling": r.dangling})
+        else:
+            for r in layer.healer.heal_all():
+                results.append({
+                    "object": f"{r.bucket}/{r.object_name}",
+                    "beforeOk": r.before_ok, "afterOk": r.after_ok,
+                    "healedDisks": r.healed_disks,
+                    "dangling": r.dangling})
+        return {"items": results}
+
+    # -- locks ----------------------------------------------------------
+
+    def h_top_locks(self, p, body):
+        out = []
+        reg = self.server.rpc_registry
+        if reg is not None:
+            svc = reg._services.get("lock")
+            if svc is not None:
+                out = svc.locker.top_locks()
+        return {"locks": out}

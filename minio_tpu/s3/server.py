@@ -488,12 +488,16 @@ class S3Server:
     def __init__(self, layer: ErasureObjects | None = None,
                  access_key: str = "minioadmin",
                  secret_key: str = "minioadmin", region: str = "us-east-1",
-                 rpc_registry=None):
+                 rpc_registry=None, iam=None):
         self.handlers = S3ApiHandlers(layer, region) if layer else None
         self.access_key = access_key
         self.secret_key = secret_key
         self.region = region
         self.rpc_registry = rpc_registry
+        self.iam = iam  # IAMSys; None = root-credentials-only mode
+        from .admin import AdminHandlers, Metrics
+        self.metrics = Metrics()
+        self.admin = AdminHandlers(self)
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
@@ -508,25 +512,96 @@ class S3Server:
         self.handlers = S3ApiHandlers(layer, self.region)
 
     def _lookup_secret(self, access_key: str) -> str | None:
+        if self.iam is not None:
+            return self.iam.lookup_secret(access_key)
         return self.secret_key if access_key == self.access_key else None
 
     def authenticate(self, req: S3Request) -> str:
         if "authorization" in req.headers:
-            return sigv4.verify_header_auth(
+            ak = sigv4.verify_header_auth(
                 req.method, req.raw_path, req.query, req.headers,
                 hashlib.sha256(req.body).hexdigest(), self._lookup_secret)
-        if "X-Amz-Signature" in req.params:
-            return sigv4.verify_presigned(
+        elif "X-Amz-Signature" in req.params:
+            ak = sigv4.verify_presigned(
                 req.method, req.raw_path, req.query, req.headers,
                 self._lookup_secret)
-        raise s3err.ERR_MISSING_AUTH
+        else:
+            raise s3err.ERR_MISSING_AUTH
+        # Temporary (STS) credentials must present their session token
+        # (ref cmd/auth-handler.go session-token validation).
+        if self.iam is not None:
+            u = self.iam.get_user(ak)
+            if u is not None and u.session_token:
+                sent = (req.headers.get("x-amz-security-token")
+                        or req.params.get("X-Amz-Security-Token", ""))
+                if sent != u.session_token:
+                    raise s3err.ERR_ACCESS_DENIED
+        return ak
+
+    @staticmethod
+    def _action_for(req: S3Request) -> tuple[str, str]:
+        """Map a request to (s3 action, resource) for policy checks
+        (ref cmd/auth-handler.go action dispatch)."""
+        m, p = req.method, req.params
+        if not req.bucket:
+            return "s3:ListAllMyBuckets", "*"
+        resource = (f"{req.bucket}/{req.key}" if req.key
+                    else req.bucket)
+        if not req.key:
+            if m == "PUT":
+                return "s3:CreateBucket", resource
+            if m == "DELETE":
+                return "s3:DeleteBucket", resource
+            if m == "POST" and "delete" in p:
+                return "s3:DeleteObject", f"{req.bucket}/*"
+            if "location" in p:
+                return "s3:GetBucketLocation", resource
+            if "uploads" in p:
+                return "s3:ListBucketMultipartUploads", resource
+            return "s3:ListBucket", resource
+        if "uploadId" in p or "uploads" in p:
+            if m == "DELETE":
+                return "s3:AbortMultipartUpload", resource
+            if m == "GET":
+                return "s3:ListMultipartUploadParts", resource
+            return "s3:PutObject", resource
+        if m in ("GET", "HEAD"):
+            if "versionId" in p:
+                return "s3:GetObjectVersion", resource
+            return "s3:GetObject", resource
+        if m == "PUT":
+            return "s3:PutObject", resource
+        if m == "DELETE":
+            return "s3:DeleteObject", resource
+        return "s3:*", resource
+
+    def authorize(self, req: S3Request, access_key: str) -> None:
+        if self.iam is None:
+            return  # root-only mode: authentication implies full access
+        action, resource = self._action_for(req)
+        ctx = {"s3:prefix": req.params.get("prefix", "")}
+        if not self.iam.is_allowed(access_key, action, resource, ctx):
+            raise s3err.ERR_ACCESS_DENIED
+        # CopyObject additionally reads the source: require GetObject
+        # on it (ref CopyObjectHandler source auth).
+        if req.method == "PUT" and req.key and \
+                "x-amz-copy-source" in req.headers:
+            src = urllib.parse.unquote(
+                req.headers["x-amz-copy-source"]).lstrip("/")
+            if not self.iam.is_allowed(access_key, "s3:GetObject", src,
+                                       ctx):
+                raise s3err.ERR_ACCESS_DENIED
 
     def route(self, req: S3Request) -> S3Response:
         h = self.handlers
         if h is None:
             raise s3err.ERR_SLOW_DOWN  # 503 until the layer is ready
-        self.authenticate(req)
+        access_key = self.authenticate(req)
         m, bucket, key, p = req.method, req.bucket, req.key, req.params
+        # STS API: POST / with Action=AssumeRole (ref cmd/sts-handlers.go).
+        if not bucket and m == "POST":
+            return self.sts_handler(req, access_key)
+        self.authorize(req, access_key)
         if not bucket:
             if m == "GET":
                 return h.list_buckets(req)
@@ -567,6 +642,91 @@ class S3Server:
             return h.delete_object(req)
         raise s3err.ERR_METHOD_NOT_ALLOWED
 
+    def handle_ops(self, method: str, raw_path: str, query: str,
+                   headers: dict[str, str], body: bytes,
+                   ) -> tuple[int, str, bytes]:
+        """Health / metrics / admin routes (non-S3 prefixes)."""
+        import json as _json
+        params = dict(urllib.parse.parse_qsl(query,
+                                             keep_blank_values=True))
+        if raw_path == "/minio-tpu/health/live":
+            return 200, "text/plain", b"OK"
+        if raw_path == "/minio-tpu/health/ready":
+            ok = self.handlers is not None
+            return (200 if ok else 503), "text/plain", \
+                (b"OK" if ok else b"initializing")
+        if raw_path == "/minio-tpu/health/cluster":
+            ok = self._cluster_healthy()
+            return (200 if ok else 503), "text/plain", \
+                (b"OK" if ok else b"degraded")
+        if raw_path == "/minio-tpu/metrics":
+            text = self.metrics.prometheus(self.layer)
+            return 200, "text/plain; version=0.0.4", text.encode()
+        if raw_path.startswith("/minio-tpu/admin/"):
+            try:
+                req = S3Request(method, raw_path, query, headers, body)
+                access_key = self.authenticate(req)
+            except APIError:
+                return 403, "application/json", _json.dumps(
+                    {"error": "authentication failed"}).encode()
+            status, out = self.admin.handle(method, raw_path, params,
+                                            body, access_key)
+            return status, "application/json", out
+        return 404, "text/plain", b"not found"
+
+    def _cluster_healthy(self) -> bool:
+        """Quorum-aware cluster check (ref ClusterCheckHandler,
+        cmd/healthcheck-handler.go:30): every set must have >= read
+        quorum of its disks reachable."""
+        layer = self.layer
+        if layer is None:
+            return False
+        from .admin import _pools
+        for pool in _pools(layer):
+            for es in pool.sets:
+                online = 0
+                for d in es.disks:
+                    try:
+                        d.disk_info()
+                        online += 1
+                    except Exception:
+                        pass
+                if online < es.k:
+                    return False
+        return True
+
+    def sts_handler(self, req: S3Request, access_key: str) -> S3Response:
+        """AssumeRole: mint temp credentials for the authenticated
+        identity (ref cmd/sts-handlers.go AssumeRole)."""
+        form = dict(urllib.parse.parse_qsl(
+            req.body.decode("utf-8", "replace")))
+        if form.get("Action") != "AssumeRole":
+            raise s3err.ERR_NOT_IMPLEMENTED
+        if self.iam is None:
+            raise s3err.ERR_NOT_IMPLEMENTED
+        try:
+            duration = int(form.get("DurationSeconds", "3600"))
+        except ValueError:
+            raise s3err.ERR_INVALID_ARGUMENT
+        session_policy = None
+        if form.get("Policy"):
+            import json as _json
+            try:
+                session_policy = _json.loads(form["Policy"])
+            except ValueError:
+                raise s3err.ERR_MALFORMED_XML
+        cred = self.iam.assume_role(access_key, duration, session_policy)
+        ns = "https://sts.amazonaws.com/doc/2011-06-15/"
+        root = Element("AssumeRoleResponse", ns)
+        result = root.child("AssumeRoleResult")
+        c = result.child("Credentials")
+        c.child("AccessKeyId", cred.access_key)
+        c.child("SecretAccessKey", cred.secret_key)
+        c.child("SessionToken", cred.session_token)
+        c.child("Expiration", _iso8601(cred.expiration))
+        return S3Response(200, root.tobytes(),
+                          {"Content-Type": "application/xml"})
+
     # ---------------- HTTP plumbing ----------------
 
     def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
@@ -598,6 +758,18 @@ class S3Server:
                         if rbody:
                             self.wfile.write(rbody)
                         return
+                    # Health, metrics, admin (ref healthcheck-router.go,
+                    # metrics-router.go, admin-router.go).
+                    if raw_path.startswith("/minio-tpu/"):
+                        status, ctype, rbody = server.handle_ops(
+                            self.command, raw_path, query, headers, body)
+                        self.send_response(status)
+                        self.send_header("Content-Type", ctype)
+                        self.send_header("Content-Length", str(len(rbody)))
+                        self.end_headers()
+                        if rbody:
+                            self.wfile.write(rbody)
+                        return
                     req = S3Request(self.command, raw_path, query, headers,
                                     body)
                     try:
@@ -615,6 +787,10 @@ class S3Server:
                             err.http_status,
                             err.xml(raw_path, req.request_id),
                             {"Content-Type": "application/xml"})
+                    api = (f"{self.command}-"
+                           f"{'object' if req.key else 'bucket' if req.bucket else 'service'}")
+                    server.metrics.record(api, resp.status, len(body),
+                                          len(resp.body))
                     self.send_response(resp.status)
                     self.send_header("x-amz-request-id", req.request_id)
                     self.send_header("Server", "MinIO-TPU")

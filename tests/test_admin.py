@@ -1,0 +1,120 @@
+"""Admin API, health, metrics tests (ref cmd/admin-handlers.go,
+cmd/healthcheck-handler.go, cmd/metrics-v2.go)."""
+
+import json
+import os
+import shutil
+
+import pytest
+
+from minio_tpu.erasure.engine import ErasureObjects
+from minio_tpu.iam.iam import ConfigStore, IAMSys
+from minio_tpu.s3.client import S3Client
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.xl import XLStorage
+
+
+@pytest.fixture
+def setup(tmp_path):
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    layer = ErasureObjects(disks, block_size=8192)
+    iam = IAMSys(ConfigStore(disks), "adminak", "adminsk-secret")
+    srv = S3Server(layer, "adminak", "adminsk-secret", iam=iam)
+    port = srv.start()
+    yield srv, port, layer, disks
+    srv.stop()
+
+
+def test_health_endpoints(setup):
+    srv, port, layer, disks = setup
+    c = S3Client("127.0.0.1", port, "adminak", "adminsk-secret")
+    r = c.request("GET", "/minio-tpu/health/live", sign=False)
+    assert r.status == 200
+    r = c.request("GET", "/minio-tpu/health/ready", sign=False)
+    assert r.status == 200
+    r = c.request("GET", "/minio-tpu/health/cluster", sign=False)
+    assert r.status == 200
+    # Wipe 3 of 4 disk roots -> below read quorum -> degraded.
+    for i in range(3):
+        shutil.rmtree(disks[i].root)
+    r = c.request("GET", "/minio-tpu/health/cluster", sign=False)
+    assert r.status == 503
+
+
+def test_metrics_exposition(setup):
+    srv, port, layer, _ = setup
+    c = S3Client("127.0.0.1", port, "adminak", "adminsk-secret")
+    c.make_bucket("mb")
+    c.put_object("mb", "o", b"x" * 1000)
+    c.get_object("mb", "o")
+    c.get_object("mb", "missing")  # 404 -> error counter
+    r = c.request("GET", "/minio-tpu/metrics", sign=False)
+    text = r.body.decode()
+    assert "minio_tpu_requests_total" in text
+    assert 'api="PUT-object"' in text
+    assert "minio_tpu_errors_total" in text
+    assert "minio_tpu_disk_online" in text
+    assert "minio_tpu_uptime_seconds" in text
+
+
+def test_admin_info_and_users(setup):
+    srv, port, layer, _ = setup
+    c = S3Client("127.0.0.1", port, "adminak", "adminsk-secret")
+    r = c.request("GET", "/minio-tpu/admin/v1/info")
+    assert r.status == 200
+    info = json.loads(r.body)
+    assert info["pools"][0]["sets"][0]["disks"] == 4
+    assert info["pools"][0]["sets"][0]["online"] == 4
+
+    # User management through the API.
+    r = c.request("POST", "/minio-tpu/admin/v1/add-user",
+                  body=json.dumps({"accessKey": "eve",
+                                   "secretKey": "evepass123456",
+                                   "policies": ["readonly"]}).encode())
+    assert r.status == 200
+    r = c.request("GET", "/minio-tpu/admin/v1/list-users")
+    users = json.loads(r.body)["users"]
+    assert any(u["accessKey"] == "eve" for u in users)
+
+    # Non-root users are rejected from admin.
+    eve = S3Client("127.0.0.1", port, "eve", "evepass123456")
+    r = eve.request("GET", "/minio-tpu/admin/v1/info")
+    assert r.status == 403
+
+    # Unsigned requests rejected.
+    r = c.request("GET", "/minio-tpu/admin/v1/info", sign=False)
+    assert r.status == 403
+
+
+def test_admin_policies(setup):
+    srv, port, layer, _ = setup
+    c = S3Client("127.0.0.1", port, "adminak", "adminsk-secret")
+    doc = {"Statement": [{"Effect": "Allow", "Action": ["s3:GetObject"],
+                          "Resource": ["arn:aws:s3:::pub/*"]}]}
+    r = c.request("POST", "/minio-tpu/admin/v1/add-policy",
+                  query="name=pub-read", body=json.dumps(doc).encode())
+    assert r.status == 200
+    r = c.request("GET", "/minio-tpu/admin/v1/list-policies")
+    assert "pub-read" in json.loads(r.body)["policies"]
+    r = c.request("POST", "/minio-tpu/admin/v1/remove-policy",
+                  query="name=pub-read")
+    assert r.status == 200
+
+
+def test_admin_heal_and_datausage(setup):
+    srv, port, layer, disks = setup
+    c = S3Client("127.0.0.1", port, "adminak", "adminsk-secret")
+    c.make_bucket("healme")
+    c.put_object("healme", "obj1", os.urandom(20000))
+    # Damage one disk's copy.
+    shutil.rmtree(os.path.join(disks[2].root, "healme", "obj1"))
+    r = c.request("POST", "/minio-tpu/admin/v1/heal",
+                  query="bucket=healme")
+    assert r.status == 200
+    items = json.loads(r.body)["items"]
+    assert items[0]["healedDisks"] == [2]
+
+    r = c.request("GET", "/minio-tpu/admin/v1/datausage")
+    usage = json.loads(r.body)["buckets"]
+    assert usage["healme"]["objects"] == 1
+    assert usage["healme"]["size"] == 20000

@@ -1,0 +1,231 @@
+"""IAM, policy evaluation, and STS tests (ref pkg/iam/policy tests,
+cmd/iam.go, cmd/sts-handlers.go)."""
+
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from minio_tpu.iam.iam import ConfigStore, IAMSys
+from minio_tpu.iam.policy import Policy, wildcard_match
+from minio_tpu.storage.xl import XLStorage
+
+
+# ---- policy engine ----
+
+
+def test_wildcard_match():
+    assert wildcard_match("s3:*", "s3:GetObject")
+    assert wildcard_match("s3:Get*", "s3:GetObject")
+    assert not wildcard_match("s3:Get*", "s3:PutObject")
+    assert wildcard_match("mybucket/*", "mybucket/a/b/c")
+    assert wildcard_match("mybucket/a?c", "mybucket/abc")
+    assert not wildcard_match("mybucket", "mybucket/a")
+
+
+def test_policy_allow_deny_default():
+    p = Policy.from_dict({
+        "Version": "2012-10-17",
+        "Statement": [
+            {"Effect": "Allow", "Action": ["s3:GetObject"],
+             "Resource": ["arn:aws:s3:::public/*"]},
+            {"Effect": "Deny", "Action": ["s3:GetObject"],
+             "Resource": ["arn:aws:s3:::public/secret/*"]},
+        ],
+    })
+    assert p.is_allowed("s3:GetObject", "public/a.txt")
+    # Explicit deny wins.
+    assert not p.is_allowed("s3:GetObject", "public/secret/x")
+    # Default deny.
+    assert not p.is_allowed("s3:GetObject", "private/a.txt")
+    assert not p.is_allowed("s3:PutObject", "public/a.txt")
+
+
+def test_policy_single_statement_dict_and_string_fields():
+    p = Policy.from_dict({
+        "Statement": {"Effect": "Allow", "Action": "s3:ListBucket",
+                      "Resource": "arn:aws:s3:::b"},
+    })
+    assert p.is_allowed("s3:ListBucket", "b")
+
+
+def test_policy_conditions():
+    p = Policy.from_dict({
+        "Statement": [{
+            "Effect": "Allow", "Action": ["s3:ListBucket"],
+            "Resource": ["arn:aws:s3:::b"],
+            "Condition": {"StringLike": {"s3:prefix": ["docs/*"]}},
+        }],
+    })
+    assert p.is_allowed("s3:ListBucket", "b",
+                        context={"s3:prefix": "docs/2024"})
+    assert not p.is_allowed("s3:ListBucket", "b",
+                            context={"s3:prefix": "pics/"})
+    assert not p.is_allowed("s3:ListBucket", "b")
+
+
+# ---- IAMSys ----
+
+
+@pytest.fixture
+def iam(tmp_path):
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    return IAMSys(ConfigStore(disks), "rootak", "rootsk-secret")
+
+
+def test_user_lifecycle_and_persistence(iam, tmp_path):
+    iam.add_user("alice", "alicepass123", ["readonly"])
+    assert iam.lookup_secret("alice") == "alicepass123"
+    assert iam.is_allowed("alice", "s3:GetObject", "b/key")
+    assert not iam.is_allowed("alice", "s3:PutObject", "b/key")
+    iam.set_user_policy("alice", ["readwrite"])
+    assert iam.is_allowed("alice", "s3:PutObject", "b/key")
+    iam.set_user_status("alice", "disabled")
+    assert iam.lookup_secret("alice") is None
+    iam.set_user_status("alice", "enabled")
+
+    # Reload from disk (fresh IAMSys, same disks).
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    iam2 = IAMSys(ConfigStore(disks), "rootak", "rootsk-secret")
+    assert iam2.lookup_secret("alice") == "alicepass123"
+    assert iam2.is_allowed("alice", "s3:PutObject", "b/key")
+
+    iam.remove_user("alice")
+    assert iam.lookup_secret("alice") is None
+
+
+def test_root_always_allowed(iam):
+    assert iam.lookup_secret("rootak") == "rootsk-secret"
+    assert iam.is_allowed("rootak", "s3:anything", "anywhere")
+    with pytest.raises(ValueError):
+        iam.add_user("rootak", "newsecret123")
+
+
+def test_custom_policy(iam):
+    iam.set_policy("bucket-x-only", {
+        "Statement": [{"Effect": "Allow", "Action": ["s3:*"],
+                       "Resource": ["arn:aws:s3:::bucket-x",
+                                    "arn:aws:s3:::bucket-x/*"]}],
+    })
+    iam.add_user("bob", "bobpass12345", ["bucket-x-only"])
+    assert iam.is_allowed("bob", "s3:GetObject", "bucket-x/file")
+    assert not iam.is_allowed("bob", "s3:GetObject", "bucket-y/file")
+    assert "bucket-x-only" in iam.list_policies()
+    with pytest.raises(ValueError):
+        iam.delete_policy("readwrite")
+
+
+def test_groups(iam):
+    iam.add_user("carol", "carolpass123")
+    iam.add_group("devs", ["carol"], ["readonly"])
+    assert iam.is_allowed("carol", "s3:GetObject", "b/k")
+    assert not iam.is_allowed("carol", "s3:PutObject", "b/k")
+
+
+def test_sts_assume_role(iam):
+    iam.add_user("dave", "davepass1234", ["readonly"])
+    cred = iam.assume_role("dave", duration_seconds=900)
+    assert cred.access_key.startswith("MTPU")
+    assert iam.lookup_secret(cred.access_key) == cred.secret_key
+    # Temp creds inherit parent policies.
+    assert iam.is_allowed(cred.access_key, "s3:GetObject", "b/k")
+    assert not iam.is_allowed(cred.access_key, "s3:PutObject", "b/k")
+    # Token verifies.
+    claims = iam.verify_token(cred.session_token)
+    assert claims["parent"] == "dave"
+    assert iam.verify_token(cred.session_token[:-4] + "0000") is None
+
+
+# ---- server integration ----
+
+
+def test_server_enforces_policies(tmp_path):
+    from minio_tpu.erasure.engine import ErasureObjects
+    from minio_tpu.s3.client import S3Client
+    from minio_tpu.s3.server import S3Server
+
+    disks = [XLStorage(str(tmp_path / f"sd{i}")) for i in range(4)]
+    layer = ErasureObjects(disks, block_size=8192)
+    iam = IAMSys(ConfigStore(disks), "rootak", "rootsk-secret")
+    srv = S3Server(layer, "rootak", "rootsk-secret", iam=iam)
+    port = srv.start()
+    try:
+        root = S3Client("127.0.0.1", port, "rootak", "rootsk-secret")
+        assert root.make_bucket("files").status == 200
+        assert root.put_object("files", "doc", b"data").status == 200
+
+        iam.add_user("reader", "readerpass12", ["readonly"])
+        reader = S3Client("127.0.0.1", port, "reader", "readerpass12")
+        assert reader.get_object("files", "doc").status == 200
+        r = reader.put_object("files", "nope", b"x")
+        assert r.status == 403 and b"AccessDenied" in r.body
+        r = reader.request("PUT", "/newbucket")
+        assert r.status == 403
+
+        # STS: reader assumes a role, temp creds work for GET.
+        r = reader.request("POST", "/",
+                           body=b"Action=AssumeRole&Version=2011-06-15",
+                           headers={"content-type":
+                                    "application/x-www-form-urlencoded"})
+        assert r.status == 200, r.body
+        doc = ET.fromstring(r.body)
+        ns = {"sts": "https://sts.amazonaws.com/doc/2011-06-15/"}
+        ak = doc.findtext(".//sts:AccessKeyId", namespaces=ns)
+        sk = doc.findtext(".//sts:SecretAccessKey", namespaces=ns)
+        tok = doc.findtext(".//sts:SessionToken", namespaces=ns)
+        assert ak and sk and tok
+        temp = S3Client("127.0.0.1", port, ak, sk)
+        hdr = {"x-amz-security-token": tok}
+        assert temp.get_object("files", "doc",
+                               headers=hdr).status == 200
+        assert temp.put_object("files", "blocked", b"x",
+                               headers=hdr).status == 403
+        # Temp creds WITHOUT the session token are refused.
+        assert temp.get_object("files", "doc").status == 403
+
+        # Unknown users still rejected.
+        bad = S3Client("127.0.0.1", port, "ghost", "ghostpass123")
+        assert bad.get_object("files", "doc").status == 403
+    finally:
+        srv.stop()
+
+
+def test_sts_session_policy_restricts(iam):
+    """Session policy = identity ∩ session (AWS semantics)."""
+    iam.add_user("frank", "frankpass123", ["readwrite"])
+    sp = {"Statement": [{"Effect": "Allow", "Action": ["s3:GetObject"],
+                         "Resource": ["arn:aws:s3:::open/*"]}]}
+    cred = iam.assume_role("frank", 900, session_policy=sp)
+    assert iam.is_allowed(cred.access_key, "s3:GetObject", "open/x")
+    # Parent allows, session policy doesn't -> denied.
+    assert not iam.is_allowed(cred.access_key, "s3:PutObject", "open/x")
+    assert not iam.is_allowed(cred.access_key, "s3:GetObject",
+                              "private/x")
+
+
+def test_copy_requires_source_read(tmp_path):
+    """CopyObject must check s3:GetObject on the source."""
+    from minio_tpu.erasure.engine import ErasureObjects
+    from minio_tpu.s3.client import S3Client
+    from minio_tpu.s3.server import S3Server
+
+    disks = [XLStorage(str(tmp_path / f"cd{i}")) for i in range(4)]
+    layer = ErasureObjects(disks, block_size=8192)
+    iam = IAMSys(ConfigStore(disks), "rootak", "rootsk-secret")
+    srv = S3Server(layer, "rootak", "rootsk-secret", iam=iam)
+    port = srv.start()
+    try:
+        root = S3Client("127.0.0.1", port, "rootak", "rootsk-secret")
+        root.make_bucket("secret")
+        root.make_bucket("open")
+        root.put_object("secret", "classified", b"top secret")
+        # Writer can PUT anywhere but read nothing.
+        iam.set_policy("open-writer", {"Statement": [
+            {"Effect": "Allow", "Action": ["s3:PutObject"],
+             "Resource": ["arn:aws:s3:::open/*"]}]})
+        iam.add_user("writer", "writerpass12", ["open-writer"])
+        w = S3Client("127.0.0.1", port, "writer", "writerpass12")
+        r = w.request("PUT", "/open/stolen",
+                      headers={"x-amz-copy-source": "/secret/classified"})
+        assert r.status == 403
+    finally:
+        srv.stop()
