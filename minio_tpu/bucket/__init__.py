@@ -1,0 +1,2 @@
+"""Per-bucket metadata: versioning, policy, tagging, lifecycle,
+notification, encryption, quota, object-lock, replication configs."""

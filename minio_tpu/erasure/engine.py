@@ -48,6 +48,12 @@ class ObjectNotFound(Exception):
     pass
 
 
+class MethodNotAllowed(Exception):
+    """GET/HEAD of a delete marker addressed by explicit versionId
+    (S3 returns 405; ref toAPIErrorCode MethodNotAllowed mapping)."""
+    pass
+
+
 class BucketNotFound(Exception):
     pass
 
@@ -330,6 +336,8 @@ class ErasureObjects:
         self._check_bucket(bucket)
         fi, _ = self._quorum_file_info(bucket, object_name, version_id)
         if fi.deleted:
+            if version_id:
+                raise MethodNotAllowed(f"{bucket}/{object_name}")
             raise ObjectNotFound(f"{bucket}/{object_name}")
         return ObjectInfo.from_file_info(fi)
 
@@ -344,6 +352,8 @@ class ErasureObjects:
             fi, agreed = self._quorum_file_info(bucket, object_name,
                                                 version_id)
             if fi.deleted:
+                if version_id:
+                    raise MethodNotAllowed(f"{bucket}/{object_name}")
                 raise ObjectNotFound(f"{bucket}/{object_name}")
             info = ObjectInfo.from_file_info(fi)
             if offset < 0 or offset > fi.size:
@@ -508,11 +518,44 @@ class ErasureObjects:
     # delete / list
 
     def delete_object(self, bucket: str, object_name: str,
-                      version_id: str = "") -> None:
+                      version_id: str = "",
+                      versioned: bool = False) -> ObjectInfo:
+        """Delete semantics (ref DeleteObject, cmd/erasure-object.go):
+        - versioned bucket + no explicit versionId -> write a delete
+          marker as the new latest version (nothing is erased);
+        - explicit versionId (or unversioned bucket) -> permanently
+          remove that version (latest null version when unversioned).
+        Returns the deleted-object descriptor (marker id when one was
+        written)."""
         self._check_bucket(bucket)
+        if versioned and version_id == "":
+            marker = FileInfo(
+                volume=bucket, name=object_name,
+                version_id=new_version_id(), deleted=True,
+                mod_time=now())
+            with self.ns_lock.write_locked(bucket, object_name):
+                _, errs = parallel_map(
+                    [lambda d=d: d.write_metadata(bucket, object_name,
+                                                  marker)
+                     for d in self.disks])
+                reduce_quorum_errs(errs, write_quorum(self.k, self.m),
+                                   "delete_object(marker)")
+            return ObjectInfo(bucket=bucket, name=object_name,
+                              version_id=marker.version_id,
+                              delete_marker=True,
+                              mod_time=marker.mod_time)
         fi = FileInfo(volume=bucket, name=object_name,
                       version_id=version_id)
+        was_marker = False
         with self.ns_lock.write_locked(bucket, object_name):
+            if version_id:
+                for d in self.disks:
+                    try:
+                        was_marker = d.read_version(
+                            bucket, object_name, version_id).deleted
+                        break
+                    except serr.StorageError:
+                        continue
             _, errs = parallel_map(
                 [lambda d=d: d.delete_version(bucket, object_name, fi)
                  for d in self.disks])
@@ -525,12 +568,56 @@ class ErasureObjects:
                                     serr.VersionNotFound)) else e
              for e in errs],
             write_quorum(self.k, self.m), "delete_object")
+        return ObjectInfo(bucket=bucket, name=object_name,
+                          version_id=version_id,
+                          delete_marker=was_marker)
 
-    def list_objects(self, bucket: str, prefix: str = "",
-                     max_keys: int = 1000) -> list[ObjectInfo]:
-        """Union-merge directory walk across disks, quorum-stat each object
-        (the metacache engine replaces this for scale)."""
+    def object_exists(self, bucket: str, object_name: str) -> bool:
+        """True when ANY version (object or delete marker) of the key
+        exists on any disk — the placement probe that, unlike
+        get_object_info, is not blinded by a delete marker being the
+        latest version."""
+        self._check_not_reserved(bucket)
+        results, _ = parallel_map(
+            [lambda d=d: d.read_versions(bucket, object_name)
+             for d in self.disks])
+        return any(r for r in results
+                   if r is not None and not isinstance(r, BaseException))
+
+    def put_object_tags(self, bucket: str, object_name: str, tags: str,
+                        version_id: str = "") -> None:
+        """Replace the object's tag set in-place in xl.meta (ref
+        PutObjectTags, cmd/erasure-object.go — a metadata-only update;
+        "" clears). Each disk rewrites ITS OWN FileInfo so per-disk
+        erasure indices stay intact."""
         self._check_bucket(bucket)
+        with self.ns_lock.write_locked(bucket, object_name):
+            fi, agreed = self._quorum_file_info(bucket, object_name,
+                                                version_id)
+            if fi.deleted:
+                if version_id:
+                    raise MethodNotAllowed(f"{bucket}/{object_name}")
+                raise ObjectNotFound(f"{bucket}/{object_name}")
+
+            def update_one(i: int):
+                own = agreed[i]
+                if own is None:
+                    return  # out-of-quorum disk; healing repairs it
+                if tags:
+                    own.metadata["x-amz-tagging"] = tags
+                else:
+                    own.metadata.pop("x-amz-tagging", None)
+                self.disks[i].write_metadata(bucket, object_name, own)
+
+            _, errs = parallel_map(
+                [lambda i=i: update_one(i)
+                 for i in range(len(self.disks))])
+            reduce_quorum_errs(errs, write_quorum(self.k, self.m),
+                               "put_object_tags")
+
+    def walk_object_names(self, bucket: str) -> list[str]:
+        """Union-merge directory walk across disks: every object name
+        present on ANY disk (partial writes within quorum still list)."""
         names: set[str] = set()
 
         def walk(disk: StorageAPI, path: str) -> None:
@@ -551,8 +638,6 @@ class ErasureObjects:
                     continue
                 walk(disk, f"{path}{e}" if path else e)
 
-        # Union across every disk so objects thin on some disks (partial
-        # writes within quorum) still list.
         for disk in self.disks:
             try:
                 base_entries = disk.list_dir(bucket, "")
@@ -561,15 +646,53 @@ class ErasureObjects:
             for e in base_entries:
                 if e.endswith("/"):
                     walk(disk, e)
+        return sorted(n.rstrip("/") for n in names)
 
+    def list_objects(self, bucket: str, prefix: str = "",
+                     max_keys: int = 1000) -> list[ObjectInfo]:
+        """Walk + quorum-stat each object (the metacache engine replaces
+        this for scale)."""
+        self._check_bucket(bucket)
         out = []
-        for name in sorted(n.rstrip("/") for n in names):
+        for name in self.walk_object_names(bucket):
             if prefix and not name.startswith(prefix):
                 continue
             try:
                 out.append(self.get_object_info(bucket, name))
-            except (ObjectNotFound, QuorumError):
+            except (ObjectNotFound, MethodNotAllowed, QuorumError):
                 continue
             if len(out) >= max_keys:
+                break
+        return out
+
+    def list_object_versions(self, bucket: str, prefix: str = "",
+                             max_keys: int = 1000) -> list[ObjectInfo]:
+        """All versions (objects + delete markers) newest-first per key
+        (ref ListObjectVersions via the same metadata walk). A version
+        counts when >= read-quorum disks agree on it."""
+        self._check_bucket(bucket)
+        rq = read_quorum(self.k)
+        out: list[ObjectInfo] = []
+        for name in self.walk_object_names(bucket):
+            if prefix and not name.startswith(prefix):
+                continue
+            results, _ = parallel_map(
+                [lambda d=d: d.read_versions(bucket, name)
+                 for d in self.disks])
+            counts: dict[tuple, int] = {}
+            fis: dict[tuple, FileInfo] = {}
+            for r in results:
+                if r is None or isinstance(r, BaseException):
+                    continue
+                for fi in r:
+                    key = fi.quorum_key()
+                    counts[key] = counts.get(key, 0) + 1
+                    fis[key] = fi
+            versions = sorted(
+                (fi for key, fi in fis.items() if counts[key] >= rq),
+                key=lambda fi: (-fi.mod_time, fi.version_id))
+            out.extend(ObjectInfo.from_file_info(fi) for fi in versions)
+            if len(out) >= max_keys:
+                out = out[:max_keys]
                 break
         return out

@@ -32,14 +32,15 @@ class ErasureServerPools:
 
     def _pool_with_object(self, bucket: str, object_name: str,
                           ) -> int | None:
-        """Only a definitive not-found means 'not here'; any other error
-        (quorum loss, I/O) aborts placement rather than risking a write
+        """Any-version probe (a delete marker as latest still pins the
+        key to its pool); only a definitive not-found means 'not here' —
+        quorum/I/O errors abort placement rather than risking a write
         landing in a second pool and later serving stale data."""
         for i, pool in enumerate(self.pools):
             try:
-                pool.get_object_info(bucket, object_name)
-                return i
-            except (ObjectNotFound, BucketNotFound):
+                if pool.object_exists(bucket, object_name):
+                    return i
+            except BucketNotFound:
                 continue
         return None
 
@@ -104,10 +105,51 @@ class ErasureServerPools:
                                bucket, object_name, version_id))
 
     def delete_object(self, bucket: str, object_name: str,
-                      version_id: str = "") -> None:
+                      version_id: str = "",
+                      versioned: bool = False) -> ObjectInfo:
+        """Delete in the pool that HOLDS the key (a versioned delete
+        must write its marker next to the existing versions, not into
+        whichever pool answers first; ref DeleteObject pool routing,
+        cmd/erasure-server-pool.go). A versioned delete of a key that
+        exists nowhere still writes a marker — into the put-placement
+        pool, per S3 semantics."""
+        idx = self._pool_with_object(bucket, object_name)
+        if idx is None:
+            if versioned and not version_id:
+                idx = self._put_pool_index(bucket, object_name)
+            else:
+                if not self.pools[0].bucket_exists(bucket):
+                    raise BucketNotFound(bucket)
+                raise ObjectNotFound(f"{bucket}/{object_name}")
+        return self.pools[idx].delete_object(bucket, object_name,
+                                             version_id,
+                                             versioned=versioned)
+
+    def object_exists(self, bucket: str, object_name: str) -> bool:
+        return self._pool_with_object(bucket, object_name) is not None
+
+    def put_object_tags(self, bucket: str, object_name: str, tags: str,
+                        version_id: str = "") -> None:
         return self._probe(bucket, object_name,
-                           lambda p: p.delete_object(
-                               bucket, object_name, version_id))
+                           lambda p: p.put_object_tags(
+                               bucket, object_name, tags, version_id))
+
+    def list_object_versions(self, bucket: str, prefix: str = "",
+                             max_keys: int = 1000) -> list[ObjectInfo]:
+        per_pool, _ = parallel_map(
+            [lambda p=p: p.list_object_versions(bucket, prefix=prefix,
+                                                max_keys=max_keys)
+             for p in self.pools])
+        merged: list[ObjectInfo] = []
+        seen: set[tuple] = set()
+        for lst in per_pool:
+            for o in lst or []:
+                key = (o.name, o.version_id)
+                if key not in seen:
+                    seen.add(key)
+                    merged.append(o)
+        merged.sort(key=lambda o: (o.name, -o.mod_time, o.version_id))
+        return merged[:max_keys]
 
     def list_objects(self, bucket: str, prefix: str = "",
                      max_keys: int = 1000) -> list[ObjectInfo]:

@@ -97,9 +97,31 @@ class ErasureSets:
             bucket, object_name, version_id)
 
     def delete_object(self, bucket: str, object_name: str,
-                      version_id: str = "") -> None:
+                      version_id: str = "",
+                      versioned: bool = False) -> ObjectInfo:
         return self.set_for(object_name).delete_object(
-            bucket, object_name, version_id)
+            bucket, object_name, version_id, versioned=versioned)
+
+    def object_exists(self, bucket: str, object_name: str) -> bool:
+        return self.set_for(object_name).object_exists(bucket, object_name)
+
+    def put_object_tags(self, bucket: str, object_name: str, tags: str,
+                        version_id: str = "") -> None:
+        return self.set_for(object_name).put_object_tags(
+            bucket, object_name, tags, version_id)
+
+    def list_object_versions(self, bucket: str, prefix: str = "",
+                             max_keys: int = 1000) -> list[ObjectInfo]:
+        per_set, _ = parallel_map(
+            [lambda s=s: s.list_object_versions(bucket, prefix=prefix,
+                                                max_keys=max_keys)
+             for s in self.sets])
+        merged: list[ObjectInfo] = []
+        for lst in per_set:
+            if lst:
+                merged.extend(lst)
+        merged.sort(key=lambda o: (o.name, -o.mod_time, o.version_id))
+        return merged[:max_keys]
 
     def list_objects(self, bucket: str, prefix: str = "",
                      max_keys: int = 1000) -> list[ObjectInfo]:

@@ -105,6 +105,10 @@ class StorageRPCService:
                                         a.get("version_id", ""))
         return {"fi": _fi_to_wire(fi)}, b""
 
+    def rpc_read_versions(self, a, p):
+        fis = self._disk(a).read_versions(a["volume"], a["path"])
+        return {"fis": [_fi_to_wire(fi) for fi in fis]}, b""
+
     def rpc_delete_version(self, a, p):
         self._disk(a).delete_version(a["volume"], a["path"],
                                      _fi_from_wire(a["fi"]))
@@ -205,6 +209,11 @@ class RemoteStorage(StorageAPI):
                                              "path": path,
                                              "version_id": version_id})
         return _fi_from_wire(res["fi"])
+
+    def read_versions(self, volume, path):
+        res, _ = self._call("read_versions", {"volume": volume,
+                                              "path": path})
+        return [_fi_from_wire(d) for d in res["fis"]]
 
     def delete_version(self, volume, path, fi):
         self._call("delete_version", {"volume": volume, "path": path,

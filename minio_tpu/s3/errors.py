@@ -110,3 +110,18 @@ ERR_NO_SUCH_TAG_SET = _e("NoSuchTagSet",
 ERR_NO_SUCH_LIFECYCLE = _e(
     "NoSuchLifecycleConfiguration",
     "The lifecycle configuration does not exist", 404)
+ERR_NO_SUCH_LIFECYCLE_CONFIG = ERR_NO_SUCH_LIFECYCLE
+ERR_MALFORMED_POLICY = _e(
+    "MalformedPolicy", "Policy has invalid resource", 400)
+ERR_NO_SUCH_SSE_CONFIG = _e(
+    "ServerSideEncryptionConfigurationNotFoundError",
+    "The server side encryption configuration was not found", 404)
+ERR_NO_SUCH_OBJECT_LOCK_CONFIG = _e(
+    "ObjectLockConfigurationNotFoundError",
+    "Object Lock configuration does not exist for this bucket", 404)
+ERR_NO_SUCH_REPLICATION_CONFIG = _e(
+    "ReplicationConfigurationNotFoundError",
+    "The replication configuration was not found", 404)
+ERR_NO_SUCH_CORS_CONFIG = _e(
+    "NoSuchCORSConfiguration",
+    "The CORS configuration does not exist", 404)

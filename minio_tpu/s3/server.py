@@ -18,7 +18,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..erasure.engine import (BucketExists, BucketNotFound, ErasureObjects,
-                              ObjectInfo, ObjectNotFound)
+                              MethodNotAllowed, ObjectInfo, ObjectNotFound)
 from ..parallel.quorum import QuorumError
 from . import errors as s3err
 from . import sigv4
@@ -97,9 +97,24 @@ class S3Response:
 class S3ApiHandlers:
     """S3 operations over an ObjectLayer (duck-typed ErasureObjects)."""
 
-    def __init__(self, layer: ErasureObjects, region: str = "us-east-1"):
+    def __init__(self, layer: ErasureObjects, region: str = "us-east-1",
+                 bucket_meta=None):
         self.layer = layer
         self.region = region
+        if bucket_meta is None:
+            from ..bucket.metadata import BucketMetadataSys
+            bucket_meta = BucketMetadataSys.for_layer(layer)
+        self.bucket_meta = bucket_meta
+
+    def _versioned(self, bucket: str) -> bool:
+        return self.bucket_meta.versioning_enabled(bucket)
+
+    @staticmethod
+    def _version_param(req: S3Request) -> str:
+        """The literal 'null' addresses the null (unversioned) version,
+        which is the empty id internally (ref nullVersionID handling)."""
+        vid = req.params.get("versionId", "")
+        return "" if vid == "null" else vid
 
     # ---------------- service ----------------
 
@@ -141,6 +156,10 @@ class S3ApiHandlers:
             raise s3err.ERR_NO_SUCH_BUCKET
         except BucketExists:
             raise s3err.ERR_BUCKET_NOT_EMPTY
+        # Drop every bucket-scoped config with the bucket — a later
+        # bucket of the same name must start clean (ref deleteBucket
+        # metadata cleanup, cmd/bucket-metadata-sys.go).
+        self.bucket_meta.delete(req.bucket)
         return S3Response(204)
 
     def get_location(self, req: S3Request) -> S3Response:
@@ -227,14 +246,26 @@ class S3ApiHandlers:
         except Exception:
             raise s3err.ERR_MALFORMED_XML
         quiet = doc.findtext("Quiet") == "true"
+        versioned = self._versioned(req.bucket)
         root = Element("DeleteResult", S3_XMLNS)
         for obj in doc.findall("Object"):
             key = obj.findtext("Key") or ""
+            vid = obj.findtext("VersionId") or ""
+            if vid == "null":
+                vid = ""
             try:
-                self.layer.delete_object(req.bucket, key)
+                deleted = self.layer.delete_object(req.bucket, key, vid,
+                                                   versioned=versioned)
                 if not quiet:
                     d = root.child("Deleted")
                     d.child("Key", key)
+                    if vid:
+                        d.child("VersionId", vid)
+                    if deleted.delete_marker:
+                        d.child("DeleteMarker", True)
+                        if deleted.version_id:
+                            d.child("DeleteMarkerVersionId",
+                                    deleted.version_id)
             except ObjectNotFound:
                 if not quiet:  # S3 treats missing keys as deleted
                     d = root.child("Deleted")
@@ -279,9 +310,12 @@ class S3ApiHandlers:
         for k, v in req.headers.items():
             if k.startswith("x-amz-meta-"):
                 meta[k] = v
+        if "x-amz-tagging" in req.headers:
+            meta["x-amz-tagging"] = req.headers["x-amz-tagging"]
         try:
-            info = self.layer.put_object(req.bucket, req.key, req.body,
-                                         metadata=meta)
+            info = self.layer.put_object(
+                req.bucket, req.key, req.body, metadata=meta,
+                versioned=self._versioned(req.bucket))
         except BucketNotFound:
             raise s3err.ERR_NO_SUCH_BUCKET
         h = {"ETag": f'"{info.etag}"'}
@@ -308,7 +342,8 @@ class S3ApiHandlers:
                     meta[k] = v
         meta.pop("etag", None)
         info = self.layer.put_object(req.bucket, req.key, data,
-                                     metadata=meta)
+                                     metadata=meta,
+                                     versioned=self._versioned(req.bucket))
         root = Element("CopyObjectResult", S3_XMLNS)
         root.child("ETag", f'"{info.etag}"')
         root.child("LastModified", _iso8601(info.mod_time))
@@ -316,7 +351,7 @@ class S3ApiHandlers:
                           {"Content-Type": "application/xml"})
 
     def get_object(self, req: S3Request, head: bool = False) -> S3Response:
-        version_id = req.params.get("versionId", "")
+        version_id = self._version_param(req)
         try:
             if head:
                 info = self.layer.get_object_info(req.bucket, req.key,
@@ -336,6 +371,8 @@ class S3ApiHandlers:
                         version_id=version_id)
         except BucketNotFound:
             raise s3err.ERR_NO_SUCH_BUCKET
+        except MethodNotAllowed:
+            raise s3err.ERR_METHOD_NOT_ALLOWED
         except ObjectNotFound:
             if version_id:
                 raise s3err.ERR_NO_SUCH_VERSION
@@ -469,15 +506,278 @@ class S3ApiHandlers:
         return S3Response(200, root.tobytes(),
                           {"Content-Type": "application/xml"})
 
-    def delete_object(self, req: S3Request) -> S3Response:
-        version_id = req.params.get("versionId", "")
+    # ---------------- versioning ----------------
+
+    def get_versioning(self, req: S3Request) -> S3Response:
+        if not self.layer.bucket_exists(req.bucket):
+            raise s3err.ERR_NO_SUCH_BUCKET
+        status = self.bucket_meta.get(req.bucket).versioning
+        root = Element("VersioningConfiguration", S3_XMLNS)
+        if status:
+            root.child("Status", status)
+        return S3Response(200, root.tobytes(),
+                          {"Content-Type": "application/xml"})
+
+    def put_versioning(self, req: S3Request) -> S3Response:
+        if not self.layer.bucket_exists(req.bucket):
+            raise s3err.ERR_NO_SUCH_BUCKET
         try:
-            self.layer.delete_object(req.bucket, req.key, version_id)
+            doc = parse(req.body)
+        except Exception:
+            raise s3err.ERR_MALFORMED_XML
+        status = doc.findtext("Status") or ""
+        if status not in ("Enabled", "Suspended"):
+            raise s3err.ERR_MALFORMED_XML
+        self.bucket_meta.update(req.bucket, versioning=status)
+        return S3Response(200)
+
+    def list_object_versions(self, req: S3Request) -> S3Response:
+        """GET /bucket?versions with key-marker/version-id-marker
+        pagination (ref ListObjectVersionsHandler,
+        cmd/bucket-listobjects-handlers.go)."""
+        if not self.layer.bucket_exists(req.bucket):
+            raise s3err.ERR_NO_SUCH_BUCKET
+        prefix = req.params.get("prefix", "")
+        delimiter = req.params.get("delimiter", "")
+        key_marker = req.params.get("key-marker", "")
+        vid_marker = req.params.get("version-id-marker", "")
+        max_keys = min(int(req.params.get("max-keys", "1000") or "1000"),
+                       1000)
+        infos = self.layer.list_object_versions(req.bucket, prefix=prefix,
+                                                max_keys=1_000_000)
+        # Build the flat entry stream first: delimiter collapse, latest
+        # flags; then cut one page out of it.
+        latest_seen: set[str] = set()
+        seen_prefix: set[str] = set()
+        entries: list[tuple] = []  # (kind, info-or-prefix, is_latest)
+        for info in infos:
+            if delimiter:
+                rest = info.name[len(prefix):]
+                if delimiter in rest:
+                    cp = prefix + rest.split(delimiter)[0] + delimiter
+                    if cp not in seen_prefix:
+                        seen_prefix.add(cp)
+                        entries.append(("prefix", cp, False))
+                    continue
+            is_latest = info.name not in latest_seen
+            latest_seen.add(info.name)
+            entries.append(("version", info, is_latest))
+
+        start = 0
+        if key_marker:
+            for i, (kind, item, _) in enumerate(entries):
+                key = item if kind == "prefix" else item.name
+                vid = "" if kind == "prefix" else (item.version_id
+                                                   or "null")
+                if key < key_marker:
+                    start = i + 1
+                elif key == key_marker:
+                    # With a version-id-marker resume AFTER that exact
+                    # version; without, skip the whole marker key.
+                    start = i + 1
+                    if vid_marker and vid == vid_marker:
+                        break
+                else:
+                    break
+        page = entries[start:start + max_keys]
+        truncated = start + max_keys < len(entries)
+
+        root = Element("ListVersionsResult", S3_XMLNS)
+        root.child("Name", req.bucket)
+        root.child("Prefix", prefix)
+        if key_marker:
+            root.child("KeyMarker", key_marker)
+        if vid_marker:
+            root.child("VersionIdMarker", vid_marker)
+        root.child("MaxKeys", max_keys)
+        if delimiter:
+            root.child("Delimiter", delimiter)
+        root.child("IsTruncated", truncated)
+        if truncated and page:
+            kind, item, _ = page[-1]
+            root.child("NextKeyMarker",
+                       item if kind == "prefix" else item.name)
+            if kind != "prefix":
+                root.child("NextVersionIdMarker",
+                           item.version_id or "null")
+        for kind, item, is_latest in page:
+            if kind == "prefix":
+                p = root.child("CommonPrefixes")
+                p.child("Prefix", item)
+                continue
+            e = root.child("DeleteMarker" if item.delete_marker
+                           else "Version")
+            e.child("Key", item.name)
+            e.child("VersionId", item.version_id or "null")
+            e.child("IsLatest", is_latest)
+            e.child("LastModified", _iso8601(item.mod_time))
+            if not item.delete_marker:
+                e.child("ETag", f'"{item.etag}"')
+                e.child("Size", item.size)
+                e.child("StorageClass", "STANDARD")
+        return S3Response(200, root.tobytes(),
+                          {"Content-Type": "application/xml"})
+
+    # ---------------- bucket configs ----------------
+
+    def _check_bucket_exists(self, req: S3Request) -> None:
+        if not self.layer.bucket_exists(req.bucket):
+            raise s3err.ERR_NO_SUCH_BUCKET
+
+    def get_bucket_policy(self, req: S3Request) -> S3Response:
+        self._check_bucket_exists(req)
+        policy = self.bucket_meta.get(req.bucket).policy
+        if not policy:
+            raise s3err.ERR_NO_SUCH_BUCKET_POLICY
+        import json as _json
+        return S3Response(200, _json.dumps(policy).encode(),
+                          {"Content-Type": "application/json"})
+
+    def put_bucket_policy(self, req: S3Request) -> S3Response:
+        self._check_bucket_exists(req)
+        import json as _json
+        try:
+            policy = _json.loads(req.body)
+            if not isinstance(policy, dict) or "Statement" not in policy:
+                raise ValueError
+        except ValueError:
+            raise s3err.ERR_MALFORMED_POLICY
+        self.bucket_meta.update(req.bucket, policy=policy)
+        return S3Response(204)
+
+    def delete_bucket_policy(self, req: S3Request) -> S3Response:
+        self._check_bucket_exists(req)
+        self.bucket_meta.update(req.bucket, policy=None)
+        return S3Response(204)
+
+    def _xml_config(self, req: S3Request, field: str, root_tag: str,
+                    missing: s3err.APIError) -> S3Response:
+        """Shared GET/PUT/DELETE plumbing for XML bucket configs
+        (lifecycle, notification, sse, tagging, object-lock,
+        replication — ref cmd/bucket-*-handlers.go)."""
+        self._check_bucket_exists(req)
+        if req.method == "GET":
+            raw = getattr(self.bucket_meta.get(req.bucket), field)
+            if not raw:
+                raise missing
+            return S3Response(200, raw.encode(),
+                              {"Content-Type": "application/xml"})
+        if req.method == "DELETE":
+            self.bucket_meta.update(req.bucket, **{field: ""})
+            return S3Response(204)
+        # PUT: validate the XML parses and the root tag matches.
+        try:
+            doc = parse(req.body)
+        except Exception:
+            raise s3err.ERR_MALFORMED_XML
+        if root_tag not in doc.tag:
+            raise s3err.ERR_MALFORMED_XML
+        self.bucket_meta.update(req.bucket,
+                                **{field: req.body.decode("utf-8")})
+        return S3Response(200)
+
+    def bucket_lifecycle(self, req: S3Request) -> S3Response:
+        return self._xml_config(req, "lifecycle_xml",
+                                "LifecycleConfiguration",
+                                s3err.ERR_NO_SUCH_LIFECYCLE_CONFIG)
+
+    def bucket_notification(self, req: S3Request) -> S3Response:
+        # GET of an unset notification config returns an empty document,
+        # not an error (ref GetBucketNotificationHandler).
+        self._check_bucket_exists(req)
+        if req.method == "GET" and not self.bucket_meta.get(
+                req.bucket).notification_xml:
+            root = Element("NotificationConfiguration", S3_XMLNS)
+            return S3Response(200, root.tobytes(),
+                              {"Content-Type": "application/xml"})
+        return self._xml_config(req, "notification_xml",
+                                "NotificationConfiguration",
+                                s3err.ERR_MALFORMED_XML)
+
+    def bucket_encryption(self, req: S3Request) -> S3Response:
+        return self._xml_config(req, "sse_xml",
+                                "ServerSideEncryptionConfiguration",
+                                s3err.ERR_NO_SUCH_SSE_CONFIG)
+
+    def bucket_tagging(self, req: S3Request) -> S3Response:
+        return self._xml_config(req, "tagging_xml", "Tagging",
+                                s3err.ERR_NO_SUCH_TAG_SET)
+
+    def bucket_object_lock(self, req: S3Request) -> S3Response:
+        return self._xml_config(req, "object_lock_xml",
+                                "ObjectLockConfiguration",
+                                s3err.ERR_NO_SUCH_OBJECT_LOCK_CONFIG)
+
+    def bucket_replication(self, req: S3Request) -> S3Response:
+        return self._xml_config(req, "replication_xml",
+                                "ReplicationConfiguration",
+                                s3err.ERR_NO_SUCH_REPLICATION_CONFIG)
+
+    # ---------------- object tagging ----------------
+
+    def object_tagging(self, req: S3Request) -> S3Response:
+        version_id = self._version_param(req)
+        if req.method == "GET":
+            try:
+                info = self.layer.get_object_info(req.bucket, req.key,
+                                                  version_id)
+            except MethodNotAllowed:
+                raise s3err.ERR_METHOD_NOT_ALLOWED
+            except (ObjectNotFound, BucketNotFound):
+                raise s3err.ERR_NO_SUCH_KEY
+            root = Element("Tagging", S3_XMLNS)
+            tagset = root.child("TagSet")
+            raw = info.metadata.get("x-amz-tagging", "")
+            for pair in raw.split("&") if raw else []:
+                k, _, v = pair.partition("=")
+                t = tagset.child("Tag")
+                t.child("Key", urllib.parse.unquote_plus(k))
+                t.child("Value", urllib.parse.unquote_plus(v))
+            return S3Response(200, root.tobytes(),
+                              {"Content-Type": "application/xml"})
+        if req.method == "DELETE":
+            self._set_object_tags(req, version_id, "")
+            return S3Response(204)
+        try:
+            doc = parse(req.body)
+            pairs = []
+            for t in doc.find("TagSet").findall("Tag"):
+                pairs.append(
+                    f"{urllib.parse.quote_plus(t.findtext('Key') or '')}"
+                    f"={urllib.parse.quote_plus(t.findtext('Value') or '')}")
+            if len(pairs) > 10:
+                raise s3err.ERR_INVALID_ARGUMENT
+        except s3err.APIError:
+            raise
+        except Exception:
+            raise s3err.ERR_MALFORMED_XML
+        self._set_object_tags(req, version_id, "&".join(pairs))
+        return S3Response(200)
+
+    def _set_object_tags(self, req: S3Request, version_id: str,
+                         tags: str) -> None:
+        try:
+            self.layer.put_object_tags(req.bucket, req.key, tags,
+                                       version_id)
+        except MethodNotAllowed:
+            raise s3err.ERR_METHOD_NOT_ALLOWED
         except (ObjectNotFound, BucketNotFound):
-            pass  # S3 DELETE is idempotent-success on missing keys
+            raise s3err.ERR_NO_SUCH_KEY
+
+    def delete_object(self, req: S3Request) -> S3Response:
+        version_id = self._version_param(req)
         h = {}
-        if version_id:
-            h["x-amz-version-id"] = version_id
+        try:
+            deleted = self.layer.delete_object(
+                req.bucket, req.key, version_id,
+                versioned=self._versioned(req.bucket))
+            if deleted.delete_marker:
+                h["x-amz-delete-marker"] = "true"
+            if deleted.version_id:
+                h["x-amz-version-id"] = deleted.version_id
+        except (ObjectNotFound, BucketNotFound):
+            if version_id:  # S3 DELETE is idempotent-success on missing keys
+                h["x-amz-version-id"] = version_id
         return S3Response(204, headers=h)
 
 
@@ -489,12 +789,15 @@ class S3Server:
                  access_key: str = "minioadmin",
                  secret_key: str = "minioadmin", region: str = "us-east-1",
                  rpc_registry=None, iam=None):
-        self.handlers = S3ApiHandlers(layer, region) if layer else None
         self.access_key = access_key
         self.secret_key = secret_key
         self.region = region
         self.rpc_registry = rpc_registry
         self.iam = iam  # IAMSys; None = root-credentials-only mode
+        self.handlers = None
+        self.bucket_meta = None
+        if layer is not None:
+            self.set_layer(layer)
         from .admin import AdminHandlers, Metrics
         self.metrics = Metrics()
         self.admin = AdminHandlers(self)
@@ -509,7 +812,9 @@ class S3Server:
         """Attach the object layer once boot completes (the reference
         serves 503 until newObjectLayer finishes,
         cmd/server-main.go:463)."""
-        self.handlers = S3ApiHandlers(layer, self.region)
+        from ..bucket.metadata import BucketMetadataSys
+        self.bucket_meta = BucketMetadataSys.for_layer(layer)
+        self.handlers = S3ApiHandlers(layer, self.region, self.bucket_meta)
 
     def _lookup_secret(self, access_key: str) -> str | None:
         if self.iam is not None:
@@ -548,6 +853,35 @@ class S3Server:
         resource = (f"{req.bucket}/{req.key}" if req.key
                     else req.bucket)
         if not req.key:
+            if "policy" in p:
+                return ({"GET": "s3:GetBucketPolicy",
+                         "PUT": "s3:PutBucketPolicy",
+                         "DELETE": "s3:DeleteBucketPolicy"}.get(
+                             m, "s3:GetBucketPolicy"), resource)
+            if "versioning" in p:
+                return ("s3:GetBucketVersioning" if m == "GET"
+                        else "s3:PutBucketVersioning", resource)
+            if "lifecycle" in p:
+                return ("s3:GetLifecycleConfiguration" if m == "GET"
+                        else "s3:PutLifecycleConfiguration", resource)
+            if "notification" in p:
+                return ("s3:GetBucketNotification" if m == "GET"
+                        else "s3:PutBucketNotification", resource)
+            if "encryption" in p:
+                return ("s3:GetEncryptionConfiguration" if m == "GET"
+                        else "s3:PutEncryptionConfiguration", resource)
+            if "tagging" in p:
+                return ("s3:GetBucketTagging" if m == "GET"
+                        else "s3:PutBucketTagging", resource)
+            if "object-lock" in p:
+                return ("s3:GetBucketObjectLockConfiguration" if m == "GET"
+                        else "s3:PutBucketObjectLockConfiguration",
+                        resource)
+            if "replication" in p:
+                return ("s3:GetReplicationConfiguration" if m == "GET"
+                        else "s3:PutReplicationConfiguration", resource)
+            if "versions" in p:
+                return "s3:ListBucketVersions", resource
             if m == "PUT":
                 return "s3:CreateBucket", resource
             if m == "DELETE":
@@ -559,6 +893,12 @@ class S3Server:
             if "uploads" in p:
                 return "s3:ListBucketMultipartUploads", resource
             return "s3:ListBucket", resource
+        if "tagging" in p:
+            if m == "GET":
+                return ("s3:GetObjectVersionTagging" if "versionId" in p
+                        else "s3:GetObjectTagging"), resource
+            return ("s3:PutObjectVersionTagging" if "versionId" in p
+                    else "s3:PutObjectTagging"), resource
         if "uploadId" in p or "uploads" in p:
             if m == "DELETE":
                 return "s3:AbortMultipartUpload", resource
@@ -607,6 +947,28 @@ class S3Server:
                 return h.list_buckets(req)
             raise s3err.ERR_METHOD_NOT_ALLOWED
         if not key:
+            # Bucket sub-resources (?policy, ?versioning, ?lifecycle...)
+            # dispatch on the query param (ref cmd/api-router.go queries()).
+            if "policy" in p:
+                if m == "GET":
+                    return h.get_bucket_policy(req)
+                if m == "PUT":
+                    return h.put_bucket_policy(req)
+                if m == "DELETE":
+                    return h.delete_bucket_policy(req)
+            if "versioning" in p:
+                if m == "GET":
+                    return h.get_versioning(req)
+                if m == "PUT":
+                    return h.put_versioning(req)
+            for param, fn in (("lifecycle", h.bucket_lifecycle),
+                              ("notification", h.bucket_notification),
+                              ("encryption", h.bucket_encryption),
+                              ("tagging", h.bucket_tagging),
+                              ("object-lock", h.bucket_object_lock),
+                              ("replication", h.bucket_replication)):
+                if param in p:
+                    return fn(req)
             if m == "PUT":
                 return h.make_bucket(req)
             if m == "HEAD":
@@ -620,8 +982,12 @@ class S3Server:
                     return h.get_location(req)
                 if "uploads" in p:
                     return h.list_multipart_uploads(req)
+                if "versions" in p:
+                    return h.list_object_versions(req)
                 return h.list_objects(req)
             raise s3err.ERR_METHOD_NOT_ALLOWED
+        if "tagging" in p:
+            return h.object_tagging(req)
         if m == "POST" and "uploads" in p:
             return h.initiate_multipart(req)
         if m == "POST" and "uploadId" in p:

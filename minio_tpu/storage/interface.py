@@ -101,6 +101,11 @@ class StorageAPI(abc.ABC):
         (ref DeleteVersion)."""
 
     @abc.abstractmethod
+    def read_versions(self, volume: str, path: str) -> list[FileInfo]:
+        """All versions of one object, newest first (ref ReadVersion on
+        the full xlMetaV2 versions array, cmd/xl-storage-format-v2.go)."""
+
+    @abc.abstractmethod
     def read_parts(self, volume: str, path: str, data_dir: str,
                    ) -> list[str]:
         """List part files of a version's data dir (ref CheckParts)."""

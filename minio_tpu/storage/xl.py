@@ -306,6 +306,11 @@ class XLStorage(StorageAPI):
             raise serr.FileNotFound(path)
         return FileInfo.from_version_dict(volume, path, v)
 
+    def read_versions(self, volume: str, path: str) -> list[FileInfo]:
+        meta = self._read_xlmeta(volume, path)
+        return [FileInfo.from_version_dict(volume, path, v)
+                for v in meta.versions]
+
     def delete_version(self, volume: str, path: str, fi: FileInfo) -> None:
         meta = self._read_xlmeta(volume, path)
         v = meta.delete_version(fi.version_id)

@@ -81,6 +81,9 @@ class NaughtyDisk(StorageAPI):
     def delete_version(self, v, p, fi):
         return self.__getattr__("delete_version")(v, p, fi)
 
+    def read_versions(self, v, p):
+        return self.__getattr__("read_versions")(v, p)
+
     def read_parts(self, v, p, dd):
         return self.__getattr__("read_parts")(v, p, dd)
 

@@ -1,0 +1,362 @@
+"""Versioning + bucket-config tests: delete markers, version listing,
+bucket policy/tagging/lifecycle configs, object tagging (the reference
+covers these in cmd/object-handlers_test.go, cmd/bucket-handlers_test.go
+and cmd/erasure-object_test.go delete-versions cases)."""
+
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from minio_tpu.erasure.engine import (ErasureObjects, MethodNotAllowed,
+                                      ObjectNotFound)
+from minio_tpu.s3.client import S3Client
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.xl import XLStorage
+
+ACCESS, SECRET = "testadmin", "testadmin-secret"
+
+
+@pytest.fixture(scope="module")
+def layer(tmp_path_factory):
+    root = tmp_path_factory.mktemp("verdisks")
+    disks = [XLStorage(str(root / f"disk{i}")) for i in range(4)]
+    return ErasureObjects(disks, block_size=64 * 1024)
+
+
+@pytest.fixture(scope="module")
+def server(layer):
+    srv = S3Server(layer, ACCESS, SECRET)
+    port = srv.start()
+    yield srv, port
+    srv.stop()
+
+
+@pytest.fixture
+def client(server):
+    _, port = server
+    return S3Client("127.0.0.1", port, ACCESS, SECRET)
+
+
+def _xml(body: bytes) -> ET.Element:
+    root = ET.fromstring(body)
+    for el in root.iter():
+        if "}" in el.tag:
+            el.tag = el.tag.split("}", 1)[1]
+    return root
+
+
+# ---------------------------------------------------------------------------
+# engine-level versioning
+
+
+def test_versioned_put_keeps_history(layer):
+    layer.make_bucket("vb")
+    i1 = layer.put_object("vb", "k", b"one", versioned=True)
+    i2 = layer.put_object("vb", "k", b"two", versioned=True)
+    assert i1.version_id and i2.version_id
+    assert i1.version_id != i2.version_id
+    # Latest wins unqualified reads; explicit version reads the past.
+    data, _ = layer.get_object("vb", "k")
+    assert data == b"two"
+    data, _ = layer.get_object("vb", "k", version_id=i1.version_id)
+    assert data == b"one"
+    versions = layer.list_object_versions("vb")
+    assert [v.version_id for v in versions] == [i2.version_id,
+                                               i1.version_id]
+
+
+def test_delete_marker_semantics(layer):
+    layer.make_bucket("vm")
+    i1 = layer.put_object("vm", "k", b"v1", versioned=True)
+    deleted = layer.delete_object("vm", "k", versioned=True)
+    assert deleted.delete_marker and deleted.version_id
+    # Unqualified GET now 404s, but the data version is still there.
+    with pytest.raises(ObjectNotFound):
+        layer.get_object("vm", "k")
+    data, _ = layer.get_object("vm", "k", version_id=i1.version_id)
+    assert data == b"v1"
+    # GET of the marker by its id -> 405 semantics.
+    with pytest.raises(MethodNotAllowed):
+        layer.get_object("vm", "k", version_id=deleted.version_id)
+    versions = layer.list_object_versions("vm")
+    assert versions[0].delete_marker
+    assert versions[0].version_id == deleted.version_id
+    # Removing the marker restores the object.
+    layer.delete_object("vm", "k", version_id=deleted.version_id)
+    data, _ = layer.get_object("vm", "k")
+    assert data == b"v1"
+    # Permanently removing the data version empties the key.
+    layer.delete_object("vm", "k", version_id=i1.version_id)
+    with pytest.raises(ObjectNotFound):
+        layer.get_object_info("vm", "k")
+
+
+def test_unversioned_delete_still_removes(layer):
+    layer.make_bucket("vu")
+    layer.put_object("vu", "k", b"x")
+    out = layer.delete_object("vu", "k")
+    assert not out.delete_marker
+    with pytest.raises(ObjectNotFound):
+        layer.get_object_info("vu", "k")
+
+
+# ---------------------------------------------------------------------------
+# S3 API versioning
+
+
+def test_api_versioning_config(client):
+    client.make_bucket("api-ver")
+    r = client.request("GET", "/api-ver", "versioning=")
+    assert r.status == 200
+    assert _xml(r.body).findtext("Status") is None
+    body = (b'<VersioningConfiguration>'
+            b'<Status>Enabled</Status></VersioningConfiguration>')
+    r = client.request("PUT", "/api-ver", "versioning=", body)
+    assert r.status == 200
+    r = client.request("GET", "/api-ver", "versioning=")
+    assert _xml(r.body).findtext("Status") == "Enabled"
+
+
+def test_api_versioned_object_flow(client):
+    client.make_bucket("api-vobj")
+    client.request("PUT", "/api-vobj", "versioning=",
+                   b"<VersioningConfiguration><Status>Enabled</Status>"
+                   b"</VersioningConfiguration>")
+    r1 = client.put_object("api-vobj", "doc", b"rev1")
+    r2 = client.put_object("api-vobj", "doc", b"rev2")
+    v1 = r1.headers["x-amz-version-id"]
+    v2 = r2.headers["x-amz-version-id"]
+    assert v1 != v2
+    # Version-addressed GET.
+    r = client.request("GET", "/api-vobj/doc", f"versionId={v1}")
+    assert r.status == 200 and r.body == b"rev1"
+    # DELETE -> marker.
+    r = client.request("DELETE", "/api-vobj/doc")
+    assert r.status == 204
+    assert r.headers.get("x-amz-delete-marker") == "true"
+    marker = r.headers["x-amz-version-id"]
+    assert client.get_object("api-vobj", "doc").status == 404
+    # ?versions listing shows marker + 2 revisions.
+    r = client.request("GET", "/api-vobj", "versions=")
+    doc = _xml(r.body)
+    markers = doc.findall("DeleteMarker")
+    versions = doc.findall("Version")
+    assert len(markers) == 1 and len(versions) == 2
+    assert markers[0].findtext("IsLatest") == "true"
+    # GET marker version -> 405.
+    r = client.request("GET", "/api-vobj/doc", f"versionId={marker}")
+    assert r.status == 405
+    # Delete the marker -> object restored.
+    r = client.request("DELETE", "/api-vobj/doc", f"versionId={marker}")
+    assert r.status == 204
+    assert client.get_object("api-vobj", "doc").body == b"rev2"
+
+
+# ---------------------------------------------------------------------------
+# bucket configs
+
+
+def test_api_bucket_policy_roundtrip(client):
+    client.make_bucket("api-pol")
+    r = client.request("GET", "/api-pol", "policy=")
+    assert r.status == 404 and b"NoSuchBucketPolicy" in r.body
+    policy = (b'{"Version":"2012-10-17","Statement":[{"Effect":"Allow",'
+              b'"Principal":{"AWS":["*"]},"Action":["s3:GetObject"],'
+              b'"Resource":["arn:aws:s3:::api-pol/*"]}]}')
+    assert client.request("PUT", "/api-pol", "policy=",
+                          policy).status == 204
+    r = client.request("GET", "/api-pol", "policy=")
+    assert r.status == 200 and b"s3:GetObject" in r.body
+    assert client.request("DELETE", "/api-pol", "policy=").status == 204
+    assert client.request("GET", "/api-pol", "policy=").status == 404
+
+
+def test_api_bucket_xml_configs(client):
+    client.make_bucket("api-cfg")
+    lc = (b'<LifecycleConfiguration><Rule><ID>r1</ID>'
+          b'<Status>Enabled</Status><Expiration><Days>30</Days>'
+          b'</Expiration></Rule></LifecycleConfiguration>')
+    assert client.request("GET", "/api-cfg", "lifecycle=").status == 404
+    assert client.request("PUT", "/api-cfg", "lifecycle=", lc).status == 200
+    r = client.request("GET", "/api-cfg", "lifecycle=")
+    assert r.status == 200 and b"<Days>30</Days>" in r.body
+    assert client.request("DELETE", "/api-cfg",
+                          "lifecycle=").status == 204
+
+    tg = (b'<Tagging><TagSet><Tag><Key>team</Key><Value>tpu</Value>'
+          b'</Tag></TagSet></Tagging>')
+    assert client.request("PUT", "/api-cfg", "tagging=", tg).status == 200
+    r = client.request("GET", "/api-cfg", "tagging=")
+    assert b"team" in r.body
+    # Unset notification returns an empty config, not 404.
+    r = client.request("GET", "/api-cfg", "notification=")
+    assert r.status == 200
+    assert b"NotificationConfiguration" in r.body
+    # Bad XML rejected.
+    assert client.request("PUT", "/api-cfg", "lifecycle=",
+                          b"<oops").status == 400
+
+
+def test_api_object_tagging(client):
+    client.make_bucket("api-otag")
+    client.put_object("api-otag", "obj", b"data")
+    tg = (b'<Tagging><TagSet><Tag><Key>env</Key><Value>prod</Value></Tag>'
+          b'<Tag><Key>x</Key><Value>1</Value></Tag></TagSet></Tagging>')
+    assert client.request("PUT", "/api-otag/obj", "tagging=",
+                          tg).status == 200
+    r = client.request("GET", "/api-otag/obj", "tagging=")
+    doc = _xml(r.body)
+    tags = {t.findtext("Key"): t.findtext("Value")
+            for t in doc.find("TagSet").findall("Tag")}
+    assert tags == {"env": "prod", "x": "1"}
+    assert client.request("DELETE", "/api-otag/obj",
+                          "tagging=").status == 204
+    r = client.request("GET", "/api-otag/obj", "tagging=")
+    assert not _xml(r.body).find("TagSet").findall("Tag")
+
+
+def test_api_multi_delete_versioned(client):
+    client.make_bucket("api-mdel")
+    client.request("PUT", "/api-mdel", "versioning=",
+                   b"<VersioningConfiguration><Status>Enabled</Status>"
+                   b"</VersioningConfiguration>")
+    client.put_object("api-mdel", "a", b"1")
+    client.put_object("api-mdel", "b", b"2")
+    body = (b"<Delete><Object><Key>a</Key></Object>"
+            b"<Object><Key>b</Key></Object></Delete>")
+    r = client.request("POST", "/api-mdel", "delete=", body)
+    assert r.status == 200
+    doc = _xml(r.body)
+    deleted = doc.findall("Deleted")
+    assert len(deleted) == 2
+    assert all(d.findtext("DeleteMarker") == "true" for d in deleted)
+    # Both keys hidden; versions remain.
+    r = client.request("GET", "/api-mdel", "versions=")
+    assert len(_xml(r.body).findall("DeleteMarker")) == 2
+    assert len(_xml(r.body).findall("Version")) == 2
+
+
+# ---------------------------------------------------------------------------
+# review regressions
+
+
+def test_versioned_delete_routes_to_owning_pool(tmp_path):
+    """A versioned DELETE must write its marker in the pool that holds
+    the object, not the first pool that answers."""
+    from minio_tpu.erasure.pools import ErasureServerPools
+    from minio_tpu.erasure.sets import ErasureSets
+
+    def mk_pool(tag):
+        disks = [XLStorage(str(tmp_path / f"{tag}-d{i}")) for i in range(4)]
+        return ErasureSets(disks, [4],
+                           "00000000-0000-0000-0000-000000000000",
+                           block_size=8192)
+
+    pools = ErasureServerPools([mk_pool("p0"), mk_pool("p1")])
+    pools.make_bucket("b")
+    # Force the object into pool 1.
+    pools.pools[1].put_object("b", "k", b"data", versioned=True)
+    deleted = pools.delete_object("b", "k", versioned=True)
+    assert deleted.delete_marker
+    # Marker went to pool 1: pool 0 has no versions of the key.
+    assert not pools.pools[0].object_exists("b", "k")
+    assert pools.pools[1].object_exists("b", "k")
+    # And the key is really hidden at the top layer.
+    with pytest.raises(ObjectNotFound):
+        pools.get_object("b", "k")
+
+
+def test_recreated_bucket_starts_clean(client):
+    client.make_bucket("reborn")
+    client.request("PUT", "/reborn", "versioning=",
+                   b"<VersioningConfiguration><Status>Enabled</Status>"
+                   b"</VersioningConfiguration>")
+    client.request("PUT", "/reborn", "policy=",
+                   b'{"Version":"2012-10-17","Statement":[]}')
+    assert client.delete_bucket("reborn").status == 204
+    client.make_bucket("reborn")
+    r = client.request("GET", "/reborn", "versioning=")
+    assert _xml(r.body).findtext("Status") is None
+    assert client.request("GET", "/reborn", "policy=").status == 404
+
+
+def test_version_id_null_addresses_null_version(client):
+    client.make_bucket("nullv")
+    client.put_object("nullv", "k", b"plain")  # null version
+    r = client.request("GET", "/nullv/k", "versionId=null")
+    assert r.status == 200 and r.body == b"plain"
+    r = client.request("DELETE", "/nullv/k", "versionId=null")
+    assert r.status == 204
+    assert client.get_object("nullv", "k").status == 404
+
+
+def test_tagging_delete_marker_is_405(client):
+    client.make_bucket("tag405")
+    client.request("PUT", "/tag405", "versioning=",
+                   b"<VersioningConfiguration><Status>Enabled</Status>"
+                   b"</VersioningConfiguration>")
+    client.put_object("tag405", "k", b"x")
+    r = client.request("DELETE", "/tag405/k")
+    marker = r.headers["x-amz-version-id"]
+    r = client.request("GET", "/tag405/k", f"tagging=&versionId={marker}")
+    assert r.status == 405
+    r = client.request("PUT", "/tag405/k", f"tagging=&versionId={marker}",
+                       b"<Tagging><TagSet><Tag><Key>a</Key>"
+                       b"<Value>b</Value></Tag></TagSet></Tagging>")
+    assert r.status == 405
+
+
+def test_list_versions_pagination(client):
+    client.make_bucket("pagv")
+    client.request("PUT", "/pagv", "versioning=",
+                   b"<VersioningConfiguration><Status>Enabled</Status>"
+                   b"</VersioningConfiguration>")
+    for i in range(6):
+        client.put_object("pagv", f"k{i}", b"x")
+    seen = []
+    key_marker, vid_marker = "", ""
+    for _ in range(10):
+        q = "versions=&max-keys=2"
+        if key_marker:
+            q += f"&key-marker={key_marker}"
+        if vid_marker:
+            q += f"&version-id-marker={vid_marker}"
+        doc = _xml(client.request("GET", "/pagv", q).body)
+        for v in doc.findall("Version"):
+            seen.append(v.findtext("Key"))
+        if doc.findtext("IsTruncated") != "true":
+            break
+        key_marker = doc.findtext("NextKeyMarker")
+        vid_marker = doc.findtext("NextVersionIdMarker") or ""
+    assert seen == [f"k{i}" for i in range(6)]
+
+
+def test_concurrent_bucket_config_updates(server):
+    import threading as _t
+    srv, _ = server
+    srv.layer.make_bucket("concur")
+    bm = srv.bucket_meta
+    errs = []
+
+    def set_versioning():
+        try:
+            for _ in range(20):
+                bm.update("concur", versioning="Enabled")
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    def set_policy():
+        try:
+            for _ in range(20):
+                bm.update("concur", policy={"Statement": []})
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [_t.Thread(target=set_versioning), _t.Thread(target=set_policy)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert not errs
+    bm._cache.clear()
+    meta = bm.get("concur")
+    assert meta.versioning == "Enabled"
+    assert meta.policy == {"Statement": []}
