@@ -137,6 +137,27 @@ def _serve(args) -> int:
     print(f"   access key: {access}")
     sys.stdout.flush()
 
+    # Notification targets from env (ref config/notify webhook subsys:
+    # MINIO_NOTIFY_WEBHOOK_ENABLE/ENDPOINT/QUEUE_DIR).
+    if os.environ.get("MINIO_NOTIFY_WEBHOOK_ENABLE", "") == "on":
+        from .event.targets import QueueStoreTarget, WebhookTarget
+        endpoint = os.environ.get("MINIO_NOTIFY_WEBHOOK_ENDPOINT", "")
+        if endpoint:
+            target = WebhookTarget(endpoint)
+            qdir = os.environ.get("MINIO_NOTIFY_WEBHOOK_QUEUE_DIR", "")
+            if qdir:
+                target = QueueStoreTarget(target, qdir)
+            server.notifier.register_target(target)
+
+    # Background data crawler: usage + lifecycle + heal sampling
+    # (ref initDataCrawler, cmd/server-main.go:497).
+    from .scanner.crawler import DataCrawler
+    crawler = DataCrawler(
+        layer, server.bucket_meta, notifier=server.notifier,
+        interval=float(os.environ.get("MINIO_CRAWLER_INTERVAL", "60")))
+    crawler.start()
+    server.crawler = crawler
+
     stop = []
     signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
     try:
@@ -144,6 +165,7 @@ def _serve(args) -> int:
             signal.pause()
     except KeyboardInterrupt:
         pass
+    crawler.stop()
     server.stop()
     return 0
 

@@ -1,0 +1,3 @@
+"""Bucket event notification subsystem (ref pkg/event/: Target
+interface targetlist.go:25, event names event.go, arn.go; fired from the
+S3 handlers via NotificationSys, cmd/notification.go:48)."""

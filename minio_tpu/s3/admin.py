@@ -149,15 +149,26 @@ class AdminHandlers:
                 "uptime": time.time() - self.server.metrics.start_time}
 
     def h_datausage(self, p, body):
+        # Serve the crawler's persisted cache when scanning runs
+        # (ref DataUsageInfoHandler reading dataUsageCache); buckets
+        # newer than the last cycle (and the no-crawler fallback) get a
+        # synchronous walk producing the SAME entry shape.
         layer = self.server.layer
-        usage: dict[str, dict] = {}
+        crawler = getattr(self.server, "crawler", None)
+        cached = crawler.data_usage() if crawler is not None else {}
+        buckets: dict[str, dict] = dict(cached.get("buckets", {}))
         for b in layer.list_buckets():
+            if b["name"] in buckets:
+                continue
             objs = layer.list_objects(b["name"], max_keys=1_000_000)
-            usage[b["name"]] = {
+            buckets[b["name"]] = {
                 "objects": len(objs),
+                "versions": len(objs),
                 "size": sum(o.size for o in objs),
+                "histogram": {},
             }
-        return {"buckets": usage}
+        return {"lastUpdate": cached.get("lastUpdate", 0.0),
+                "buckets": buckets}
 
     # -- users / policies ----------------------------------------------
 

@@ -98,13 +98,30 @@ class S3ApiHandlers:
     """S3 operations over an ObjectLayer (duck-typed ErasureObjects)."""
 
     def __init__(self, layer: ErasureObjects, region: str = "us-east-1",
-                 bucket_meta=None):
+                 bucket_meta=None, notifier=None):
         self.layer = layer
         self.region = region
         if bucket_meta is None:
             from ..bucket.metadata import BucketMetadataSys
             bucket_meta = BucketMetadataSys.for_layer(layer)
         self.bucket_meta = bucket_meta
+        if notifier is None:
+            from ..event.notifier import NotificationSys
+            notifier = NotificationSys(bucket_meta, region)
+        self.notifier = notifier
+
+    def _notify(self, event_name: str, bucket: str, key: str,
+                info: ObjectInfo | None = None,
+                user: str = "") -> None:
+        """Fire a bucket event (ref sendEvent calls at the end of every
+        object handler, cmd/object-handlers.go)."""
+        from ..event.event import Event
+        self.notifier.send(Event(
+            event_name=event_name, bucket=bucket, key=key,
+            size=info.size if info else 0,
+            etag=info.etag if info else "",
+            version_id=info.version_id if info else "",
+            region=self.region, user_identity=user))
 
     def _versioned(self, bucket: str) -> bool:
         return self.bucket_meta.versioning_enabled(bucket)
@@ -256,6 +273,11 @@ class S3ApiHandlers:
             try:
                 deleted = self.layer.delete_object(req.bucket, key, vid,
                                                    versioned=versioned)
+                from ..event import event as ev
+                self._notify(
+                    ev.OBJECT_REMOVED_DELETE_MARKER
+                    if deleted.delete_marker else ev.OBJECT_REMOVED_DELETE,
+                    req.bucket, key, deleted)
                 if not quiet:
                     d = root.child("Deleted")
                     d.child("Key", key)
@@ -321,6 +343,8 @@ class S3ApiHandlers:
         h = {"ETag": f'"{info.etag}"'}
         if info.version_id:
             h["x-amz-version-id"] = info.version_id
+        from ..event import event as ev
+        self._notify(ev.OBJECT_CREATED_PUT, req.bucket, req.key, info)
         return S3Response(200, headers=h)
 
     def copy_object(self, req: S3Request) -> S3Response:
@@ -347,6 +371,8 @@ class S3ApiHandlers:
         root = Element("CopyObjectResult", S3_XMLNS)
         root.child("ETag", f'"{info.etag}"')
         root.child("LastModified", _iso8601(info.mod_time))
+        from ..event import event as ev
+        self._notify(ev.OBJECT_CREATED_COPY, req.bucket, req.key, info)
         return S3Response(200, root.tobytes(),
                           {"Content-Type": "application/xml"})
 
@@ -379,6 +405,10 @@ class S3ApiHandlers:
             raise s3err.ERR_NO_SUCH_KEY
 
         headers = self._object_headers(info)
+        from ..event import event as ev
+        self._notify(ev.OBJECT_ACCESSED_HEAD if head
+                     else ev.OBJECT_ACCESSED_GET,
+                     req.bucket, req.key, info)
         if head:
             headers["Content-Length"] = str(info.size)
             return S3Response(200, b"", headers)
@@ -458,6 +488,9 @@ class S3ApiHandlers:
         root.child("Bucket", req.bucket)
         root.child("Key", req.key)
         root.child("ETag", f'"{info.etag}"')
+        from ..event import event as ev
+        self._notify(ev.OBJECT_CREATED_COMPLETE_MULTIPART,
+                     req.bucket, req.key, info)
         return S3Response(200, root.tobytes(),
                           {"Content-Type": "application/xml"})
 
@@ -775,6 +808,11 @@ class S3ApiHandlers:
                 h["x-amz-delete-marker"] = "true"
             if deleted.version_id:
                 h["x-amz-version-id"] = deleted.version_id
+            from ..event import event as ev
+            self._notify(
+                ev.OBJECT_REMOVED_DELETE_MARKER if deleted.delete_marker
+                else ev.OBJECT_REMOVED_DELETE,
+                req.bucket, req.key, deleted)
         except (ObjectNotFound, BucketNotFound):
             if version_id:  # S3 DELETE is idempotent-success on missing keys
                 h["x-amz-version-id"] = version_id
@@ -801,6 +839,7 @@ class S3Server:
         from .admin import AdminHandlers, Metrics
         self.metrics = Metrics()
         self.admin = AdminHandlers(self)
+        self.crawler = None  # attached by serve when scanning is on
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
@@ -1179,7 +1218,13 @@ class S3Server:
         self._thread.start()
         return self._httpd.server_address[1]
 
+    @property
+    def notifier(self):
+        return self.handlers.notifier if self.handlers else None
+
     def stop(self) -> None:
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
+        if self.notifier is not None:
+            self.notifier.close()

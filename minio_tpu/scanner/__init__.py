@@ -1,0 +1,2 @@
+"""Background data scanner: usage accounting, lifecycle enforcement,
+heal sampling (ref cmd/data-crawler.go, cmd/data-usage-cache.go)."""

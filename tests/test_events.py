@@ -1,0 +1,235 @@
+"""Event notification tests: rules matching, webhook delivery, queue
+store retry, end-to-end firing from the S3 handlers (ref
+pkg/event/*_test.go and bucket notification handler tests)."""
+
+import http.server
+import json
+import threading
+import time
+
+import pytest
+
+from minio_tpu.erasure.engine import ErasureObjects
+from minio_tpu.event import event as ev
+from minio_tpu.event.notifier import NotificationSys
+from minio_tpu.event.rules import (RulesMap, _match_simple,
+                                   parse_notification_xml)
+from minio_tpu.event.targets import (MemoryTarget, QueueStoreTarget,
+                                     WebhookTarget)
+from minio_tpu.s3.client import S3Client
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.xl import XLStorage
+
+ACCESS, SECRET = "testadmin", "testadmin-secret"
+
+
+def test_wildcard_match():
+    assert _match_simple("*", "anything")
+    assert _match_simple("images/*", "images/cat.png")
+    assert not _match_simple("images/*", "docs/cat.png")
+    assert _match_simple("*.png", "images/cat.png")
+    assert not _match_simple("*.png", "cat.jpg")
+    assert _match_simple("images/*.png", "images/cat.png")
+    assert not _match_simple("images/*.png", "images/cat.jpg")
+    assert _match_simple("exact", "exact")
+    assert not _match_simple("exact", "exactly")
+
+
+def test_parse_notification_xml():
+    xml = """<NotificationConfiguration>
+      <QueueConfiguration>
+        <Id>1</Id>
+        <Filter><S3Key>
+          <FilterRule><Name>prefix</Name><Value>images/</Value></FilterRule>
+          <FilterRule><Name>suffix</Name><Value>.jpg</Value></FilterRule>
+        </S3Key></Filter>
+        <Queue>arn:minio-tpu:sqs::1:webhook</Queue>
+        <Event>s3:ObjectCreated:*</Event>
+      </QueueConfiguration>
+    </NotificationConfiguration>"""
+    rules = parse_notification_xml(xml)
+    assert rules.match(ev.OBJECT_CREATED_PUT, "images/a.jpg") == {
+        "arn:minio-tpu:sqs::1:webhook"}
+    assert not rules.match(ev.OBJECT_CREATED_PUT, "images/a.png")
+    assert not rules.match(ev.OBJECT_REMOVED_DELETE, "images/a.jpg")
+    # ObjectCreated:* expanded to all concrete creation events.
+    assert rules.match(ev.OBJECT_CREATED_COPY, "images/b.jpg")
+
+
+def test_event_record_shape():
+    e = ev.Event(event_name=ev.OBJECT_CREATED_PUT, bucket="b",
+                 key="dir/o name.txt", size=42, etag="abc",
+                 version_id="v1")
+    rec = e.to_record()
+    assert rec["eventName"] == "s3:ObjectCreated:Put"
+    assert rec["s3"]["bucket"]["name"] == "b"
+    assert rec["s3"]["object"]["key"] == "dir/o%20name.txt"
+    assert rec["s3"]["object"]["size"] == 42
+    assert rec["s3"]["object"]["versionId"] == "v1"
+
+
+class _Sink(http.server.BaseHTTPRequestHandler):
+    received: list[dict] = []
+    fail = False
+
+    def do_POST(self):
+        body = self.rfile.read(int(self.headers["Content-Length"]))
+        if _Sink.fail:
+            self.send_response(500)
+            self.end_headers()
+            return
+        _Sink.received.append(json.loads(body))
+        self.send_response(200)
+        self.end_headers()
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def sink():
+    _Sink.received = []
+    _Sink.fail = False
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Sink)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield httpd.server_address[1]
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def _wait_for(cond, timeout=5.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_webhook_target_delivery(sink):
+    t = WebhookTarget(f"http://127.0.0.1:{sink}/hook")
+    t.send({"hello": "world"})
+    assert _Sink.received == [{"hello": "world"}]
+
+
+def test_queue_store_retries_until_sink_recovers(sink, tmp_path):
+    _Sink.fail = True
+    t = QueueStoreTarget(WebhookTarget(f"http://127.0.0.1:{sink}/hook"),
+                         str(tmp_path / "queue"))
+    t.RETRY_INTERVAL = 0.1
+    t.send({"n": 1})
+    t.send({"n": 2})
+    assert t.pending() == 2  # parked on disk while the sink is down
+    _Sink.fail = False
+    assert _wait_for(lambda: t.pending() == 0)
+    assert _wait_for(lambda: len(_Sink.received) == 2)
+    assert [r["n"] for r in _Sink.received] == [1, 2]  # order kept
+    t.close()
+
+
+def test_notifier_routing():
+    n = NotificationSys()
+    mem = MemoryTarget()
+    n.register_target(mem)
+    rules = RulesMap()
+    rules.add(["s3:ObjectCreated:*"], "logs/*", mem.arn())
+    n.set_rules("b", rules)
+    n.send(ev.Event(event_name=ev.OBJECT_CREATED_PUT, bucket="b",
+                    key="logs/x"))
+    n.send(ev.Event(event_name=ev.OBJECT_CREATED_PUT, bucket="b",
+                    key="data/x"))      # filtered out
+    n.send(ev.Event(event_name=ev.OBJECT_REMOVED_DELETE, bucket="b",
+                    key="logs/x"))      # event not subscribed
+    assert _wait_for(lambda: len(mem.records) == 1)
+    time.sleep(0.1)
+    assert len(mem.records) == 1
+    assert mem.records[0]["Records"][0]["s3"]["object"]["key"] == "logs/x"
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    root = tmp_path_factory.mktemp("evdisks")
+    disks = [XLStorage(str(root / f"disk{i}")) for i in range(4)]
+    srv = S3Server(ErasureObjects(disks, block_size=64 * 1024),
+                   ACCESS, SECRET)
+    port = srv.start()
+    yield srv, port
+    srv.stop()
+
+
+def test_e2e_events_from_s3_handlers(server):
+    srv, port = server
+    client = S3Client("127.0.0.1", port, ACCESS, SECRET)
+    mem = MemoryTarget()
+    srv.notifier.register_target(mem)
+    client.make_bucket("evb")
+    # Subscribe via the real S3 notification config API.
+    xml = f"""<NotificationConfiguration><QueueConfiguration>
+        <Id>1</Id><Queue>{mem.arn()}</Queue>
+        <Event>s3:ObjectCreated:*</Event>
+        <Event>s3:ObjectRemoved:*</Event>
+        </QueueConfiguration></NotificationConfiguration>"""
+    r = client.request("PUT", "/evb", "notification=", xml.encode())
+    assert r.status == 200
+    client.put_object("evb", "hello.txt", b"hi")
+    client.delete_object("evb", "hello.txt")
+    assert _wait_for(lambda: len(mem.records) >= 2)
+    names = [r["EventName"] for r in mem.records]
+    assert "s3:ObjectCreated:Put" in names
+    assert "s3:ObjectRemoved:Delete" in names
+    keys = {r["Key"] for r in mem.records}
+    assert keys == {"evb/hello.txt"}
+
+
+# ---------------------------------------------------------------------------
+# review regressions
+
+
+def test_webhook_preserves_query_string(sink):
+    t = WebhookTarget(f"http://127.0.0.1:{sink}/hook?token=abc")
+    assert t._path == "/hook?token=abc"
+    t.send({"q": 1})
+    assert _Sink.received == [{"q": 1}]
+
+
+def test_queue_store_preserves_order_across_recovery(sink, tmp_path):
+    """New events must park behind queued ones after a sink outage."""
+    _Sink.fail = True
+    t = QueueStoreTarget(WebhookTarget(f"http://127.0.0.1:{sink}/hook"),
+                         str(tmp_path / "q2"))
+    t.RETRY_INTERVAL = 0.3
+    t.send({"n": 1})          # fails -> queued
+    _Sink.fail = False        # sink healthy again...
+    t.send({"n": 2})          # ...but 1 is still queued: 2 must queue too
+    assert _wait_for(lambda: len(_Sink.received) == 2)
+    assert [r["n"] for r in _Sink.received] == [1, 2]
+    t.close()
+
+
+def test_crawler_expiry_fires_removal_event(tmp_path):
+    import time as _time
+    from minio_tpu.bucket.metadata import BucketMetadataSys
+    from minio_tpu.event.rules import RulesMap
+    from minio_tpu.scanner.crawler import DataCrawler
+
+    layer = ErasureObjects(
+        [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)],
+        block_size=8192)
+    bm = BucketMetadataSys.for_layer(layer)
+    notifier = NotificationSys(bm)
+    mem = MemoryTarget()
+    notifier.register_target(mem)
+    rules = RulesMap()
+    rules.add(["s3:ObjectRemoved:*"], "*", mem.arn())
+    notifier.set_rules("ilm", rules)
+    layer.make_bucket("ilm")
+    layer.put_object("ilm", "gone", b"x")
+    bm.update("ilm", lifecycle_xml="""<LifecycleConfiguration><Rule>
+        <Status>Enabled</Status><Prefix></Prefix>
+        <Expiration><Days>1</Days></Expiration>
+        </Rule></LifecycleConfiguration>""")
+    crawler = DataCrawler(layer, bm, notifier=notifier,
+                          heal_sample=10**9)
+    crawler.crawl_once(now=_time.time() + 2 * 24 * 3600)
+    assert _wait_for(lambda: len(mem.records) == 1)
+    assert mem.records[0]["EventName"] == "s3:ObjectRemoved:Delete"

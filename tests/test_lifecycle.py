@@ -1,0 +1,166 @@
+"""Lifecycle rules + data crawler tests (ref pkg/bucket/lifecycle
+lifecycle_test.go and cmd/data-crawler lifecycle application)."""
+
+import time
+
+import pytest
+
+from minio_tpu.bucket.lifecycle import (DELETE, DELETE_MARKER,
+                                        DELETE_VERSION, NONE, Lifecycle)
+from minio_tpu.bucket.metadata import BucketMetadataSys
+from minio_tpu.erasure.engine import ErasureObjects, ObjectNotFound
+from minio_tpu.scanner.crawler import DataCrawler
+from minio_tpu.storage.xl import XLStorage
+
+DAY = 24 * 3600.0
+
+
+def make_layer(tmp_path, n=4):
+    disks = [XLStorage(str(tmp_path / f"disk{i}")) for i in range(n)]
+    return ErasureObjects(disks, block_size=8192)
+
+
+# ---------------------------------------------------------------------------
+# rules engine
+
+
+def test_parse_and_expire_by_days():
+    lc = Lifecycle.parse("""<LifecycleConfiguration><Rule>
+        <ID>r</ID><Status>Enabled</Status><Prefix>logs/</Prefix>
+        <Expiration><Days>30</Days></Expiration>
+        </Rule></LifecycleConfiguration>""")
+    now = time.time()
+    old = now - 31 * DAY
+    fresh = now - DAY
+    assert lc.compute_action("logs/a", old, now=now) == DELETE
+    assert lc.compute_action("logs/a", fresh, now=now) == NONE
+    assert lc.compute_action("data/a", old, now=now) == NONE  # prefix
+    # Disabled rules are inert.
+    lc2 = Lifecycle.parse("""<LifecycleConfiguration><Rule>
+        <Status>Disabled</Status><Prefix></Prefix>
+        <Expiration><Days>1</Days></Expiration>
+        </Rule></LifecycleConfiguration>""")
+    assert lc2.compute_action("x", 0.0, now=now) == NONE
+
+
+def test_expire_by_date_and_filter_and():
+    lc = Lifecycle.parse("""<LifecycleConfiguration><Rule>
+        <Status>Enabled</Status>
+        <Filter><And><Prefix>p/</Prefix>
+          <Tag><Key>tier</Key><Value>tmp</Value></Tag>
+        </And></Filter>
+        <Expiration><Date>2020-01-01</Date></Expiration>
+        </Rule></LifecycleConfiguration>""")
+    now = time.time()
+    assert lc.compute_action("p/x", now - 10, tags={"tier": "tmp"},
+                             now=now) == DELETE
+    assert lc.compute_action("p/x", now - 10, tags={}, now=now) == NONE
+    assert lc.compute_action("q/x", now - 10, tags={"tier": "tmp"},
+                             now=now) == NONE
+
+
+def test_noncurrent_and_marker_rules():
+    lc = Lifecycle.parse("""<LifecycleConfiguration><Rule>
+        <Status>Enabled</Status><Prefix></Prefix>
+        <Expiration>
+          <ExpiredObjectDeleteMarker>true</ExpiredObjectDeleteMarker>
+        </Expiration>
+        <NoncurrentVersionExpiration><NoncurrentDays>7</NoncurrentDays>
+        </NoncurrentVersionExpiration>
+        </Rule></LifecycleConfiguration>""")
+    now = time.time()
+    assert lc.compute_action("k", now - 8 * DAY, is_latest=False,
+                             now=now) == DELETE_VERSION
+    assert lc.compute_action("k", now - 6 * DAY, is_latest=False,
+                             now=now) == NONE
+    assert lc.compute_action("k", now - DAY, delete_marker=True,
+                             sole_version=True, now=now) == DELETE_MARKER
+    assert lc.compute_action("k", now - DAY, delete_marker=True,
+                             sole_version=False, now=now) == NONE
+
+
+# ---------------------------------------------------------------------------
+# crawler
+
+
+@pytest.fixture
+def stack(tmp_path):
+    layer = make_layer(tmp_path)
+    bm = BucketMetadataSys.for_layer(layer)
+    crawler = DataCrawler(layer, bm, heal_sample=10**9)
+    return layer, bm, crawler
+
+
+def test_crawler_usage_accounting(stack):
+    layer, bm, crawler = stack
+    layer.make_bucket("u1")
+    layer.make_bucket("u2")
+    layer.put_object("u1", "a", b"x" * 100)
+    layer.put_object("u1", "b", b"x" * 2000)
+    layer.put_object("u2", "c", b"x" * 300)
+    usage = crawler.crawl_once()
+    assert usage["buckets"]["u1"]["objects"] == 2
+    assert usage["buckets"]["u1"]["size"] == 2100
+    assert usage["buckets"]["u2"]["objects"] == 1
+    hist = usage["buckets"]["u1"]["histogram"]
+    assert hist["LESS_THAN_1024_B"] == 1
+    assert hist["BETWEEN_1024_B_AND_1_MB"] == 1
+    # Persisted: a fresh crawler resumes with the stored cache.
+    crawler2 = DataCrawler(layer, bm)
+    assert crawler2.last_usage["buckets"]["u1"]["size"] == 2100
+
+
+def test_crawler_applies_expiry(stack):
+    layer, bm, crawler = stack
+    layer.make_bucket("exp")
+    layer.put_object("exp", "old/doom", b"bye")
+    layer.put_object("exp", "keep/me", b"hi")
+    bm.update("exp", lifecycle_xml="""<LifecycleConfiguration><Rule>
+        <Status>Enabled</Status><Prefix>old/</Prefix>
+        <Expiration><Days>7</Days></Expiration>
+        </Rule></LifecycleConfiguration>""")
+    # Pretend the sweep happens 8 days from now.
+    crawler.crawl_once(now=time.time() + 8 * DAY)
+    with pytest.raises(ObjectNotFound):
+        layer.get_object_info("exp", "old/doom")
+    assert layer.get_object_info("exp", "keep/me").size == 2
+
+
+def test_crawler_versioned_expiry_writes_marker(stack):
+    layer, bm, crawler = stack
+    layer.make_bucket("vexp")
+    bm.update("vexp", versioning="Enabled",
+              lifecycle_xml="""<LifecycleConfiguration><Rule>
+        <Status>Enabled</Status><Prefix></Prefix>
+        <Expiration><Days>7</Days></Expiration>
+        </Rule></LifecycleConfiguration>""")
+    info = layer.put_object("vexp", "k", b"data", versioned=True)
+    crawler.crawl_once(now=time.time() + 8 * DAY)
+    # Expired current version of a versioned bucket -> delete marker,
+    # data version retained.
+    with pytest.raises(ObjectNotFound):
+        layer.get_object_info("vexp", "k")
+    data, _ = layer.get_object("vexp", "k", version_id=info.version_id)
+    assert data == b"data"
+
+
+def test_crawler_noncurrent_expiry(stack):
+    layer, bm, crawler = stack
+    layer.make_bucket("ncv")
+    bm.update("ncv", versioning="Enabled",
+              lifecycle_xml="""<LifecycleConfiguration><Rule>
+        <Status>Enabled</Status><Prefix></Prefix>
+        <NoncurrentVersionExpiration><NoncurrentDays>7</NoncurrentDays>
+        </NoncurrentVersionExpiration>
+        </Rule></LifecycleConfiguration>""")
+    v1 = layer.put_object("ncv", "k", b"one", versioned=True)
+    v2 = layer.put_object("ncv", "k", b"two", versioned=True)
+    # v1 became noncurrent when v2 replaced it (just now): not expired.
+    crawler.crawl_once()
+    assert len(layer.list_object_versions("ncv")) == 2
+    # 8 days on, the noncurrent version goes; the current one stays.
+    crawler.crawl_once(now=time.time() + 8 * DAY)
+    versions = layer.list_object_versions("ncv")
+    assert [v.version_id for v in versions] == [v2.version_id]
+    data, _ = layer.get_object("ncv", "k")
+    assert data == b"two"
