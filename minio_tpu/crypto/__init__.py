@@ -1,0 +1,1 @@
+"""Server-side encryption (ref cmd/crypto/, cmd/encryption-v1.go)."""

@@ -94,11 +94,21 @@ class MultipartUploads:
                 continue
         raise UploadNotFound(upload_id)
 
+    def get_upload_meta(self, bucket: str, object_name: str,
+                        upload_id: str) -> dict:
+        """The metadata captured at initiate time (SSE envelope,
+        content-type, user meta — ref fs/erasure multipart meta)."""
+        return dict(self._load_upload(bucket, object_name,
+                                      upload_id).get("meta", {}))
+
     # -- parts ------------------------------------------------------------
 
     def put_object_part(self, bucket: str, object_name: str,
                         upload_id: str, part_number: int,
-                        data: bytes) -> dict:
+                        data: bytes,
+                        actual_size: int | None = None) -> dict:
+        """actual_size: pre-transform (plaintext/uncompressed) length
+        when the handler encrypted or compressed the part body."""
         eng = self.engine
         if not 1 <= part_number <= 10000:
             raise InvalidPart(f"part number {part_number}")
@@ -108,8 +118,11 @@ class MultipartUploads:
         data = bytes(data)
         etag = hashlib.md5(data).hexdigest()
         shard_streams = eng._encode_object(data)
-        part_meta = json.dumps({"number": part_number, "size": len(data),
-                                "etag": etag}).encode()
+        part_meta = json.dumps({
+            "number": part_number, "size": len(data), "etag": etag,
+            "actualSize": (actual_size if actual_size is not None
+                           else len(data)),
+        }).encode()
 
         def write_one(i: int):
             disk = eng.disks[i]
@@ -215,54 +228,62 @@ class MultipartUploads:
             meta = have.get(num)
             if meta is None or meta["etag"].strip('"') != etag.strip('"'):
                 raise InvalidPart(f"part {num}")
-            if idx != last_idx and meta["size"] < self.min_part_size:
-                raise PartTooSmall(f"part {num}: {meta['size']} bytes")
+            # Size floor applies to the LOGICAL (pre-SSE/compression)
+            # length — ciphertext expansion must not mask a too-small
+            # part (ref globalMinPartSize check on actual size).
+            logical = meta.get("actualSize", meta["size"])
+            if idx != last_idx and logical < self.min_part_size:
+                raise PartTooSmall(f"part {num}: {logical} bytes")
             part_infos.append(ObjectPartInfo(
-                number=num, size=meta["size"], actual_size=meta["size"],
+                number=num, size=meta["size"],
+                actual_size=meta.get("actualSize", meta["size"]),
                 etag=meta["etag"]))
 
         total_size = sum(p.size for p in part_infos)
+        total_actual = sum(p.actual_size for p in part_infos)
         etag = multipart_etag([p.etag for p in part_infos])
         data_dir = new_data_dir()
         mod_time = now()
         meta = dict(up.get("meta") or {})
         meta["etag"] = etag
+        if total_actual != total_size:
+            # Handler-transformed parts (SSE/compression): record the
+            # logical object length (ref X-Minio-Internal-actual-size).
+            meta["x-internal-actual-size"] = str(total_actual)
         wq = write_quorum(eng.k, eng.m)
 
         def commit_one(i: int):
             disk = eng.disks[i]
             tmp_path = f"{TMP_PATH}/{uuid.uuid4()}"
             try:
-                # COPY this disk's part shards into the staging data dir,
-                # renumbered to the client's part order (1..P). Copy, not
-                # rename: a failed quorum must leave the upload intact so
-                # the client can retry complete (cleanup happens only
-                # after quorum success).
+                # COPY this disk's part shards into the staging data
+                # dir, KEEPING the client's part numbers (SSE derives
+                # per-part keys from them, and ListParts reports them;
+                # ref AWS part-number semantics). Copy, not rename: a
+                # failed quorum must leave the upload intact so the
+                # client can retry complete (cleanup happens only after
+                # quorum success).
                 if total_size > 0:
-                    for new_num, p in enumerate(part_infos, start=1):
+                    for p in part_infos:
                         shard = disk.read_all(MINIO_META_BUCKET,
                                               f"{base}/part.{p.number}")
                         disk.create_file(
                             MINIO_META_BUCKET,
-                            f"{tmp_path}/{data_dir}/part.{new_num}",
+                            f"{tmp_path}/{data_dir}/part.{p.number}",
                             shard)
                 fi = FileInfo(
                     volume=bucket, name=object_name, version_id="",
                     data_dir=data_dir if total_size > 0 else "",
                     size=total_size, mod_time=mod_time, metadata=meta,
-                    parts=[ObjectPartInfo(number=n, size=p.size,
-                                          actual_size=p.actual_size,
-                                          etag=p.etag)
-                           for n, p in enumerate(part_infos, start=1)],
+                    parts=list(part_infos),
                     erasure=ErasureInfo(
                         data_blocks=eng.k, parity_blocks=eng.m,
                         block_size=eng.block_size, index=dist[i],
                         distribution=list(dist),
-                        checksums=[{"part": n,
+                        checksums=[{"part": p.number,
                                     "algorithm": bitrot.DEFAULT_ALGORITHM,
                                     "hash": ""}
-                                   for n in range(1,
-                                                  len(part_infos) + 1)]),
+                                   for p in part_infos]),
                 )
                 if total_size > 0:
                     disk.rename_data(MINIO_META_BUCKET, tmp_path, fi,

@@ -200,10 +200,16 @@ class _PoolsMultipart:
             bucket, object_name, metadata)
 
     def put_object_part(self, bucket, object_name, upload_id,
-                        part_number, data):
+                        part_number, data, actual_size=None):
         pool = self._pool_for_upload(bucket, object_name, upload_id)
         return pool.multipart.put_object_part(
-            bucket, object_name, upload_id, part_number, data)
+            bucket, object_name, upload_id, part_number, data,
+            actual_size=actual_size)
+
+    def get_upload_meta(self, bucket, object_name, upload_id):
+        pool = self._pool_for_upload(bucket, object_name, upload_id)
+        return pool.multipart.get_upload_meta(bucket, object_name,
+                                              upload_id)
 
     def list_parts(self, bucket, object_name, upload_id):
         pool = self._pool_for_upload(bucket, object_name, upload_id)

@@ -163,7 +163,7 @@ class _SetsMultipart:
 
         if name in ("new_multipart_upload", "put_object_part",
                     "list_parts", "complete_multipart_upload",
-                    "abort_multipart_upload"):
+                    "abort_multipart_upload", "get_upload_meta"):
             return dispatch
         if name == "list_uploads":
             def list_uploads(bucket, prefix=""):

@@ -22,16 +22,18 @@ _TRIED = False
 
 
 def _build_and_load() -> ctypes.CDLL | None:
-    src = os.path.join(_HERE, "highwayhash.cc")
+    srcs = [os.path.join(_HERE, "highwayhash.cc"),
+            os.path.join(_HERE, "lzblock.cc")]
     so = os.path.join(_BUILD_DIR, "libminio_tpu_native.so")
     try:
         if (not os.path.exists(so)
-                or os.path.getmtime(so) < os.path.getmtime(src)):
+                or any(os.path.getmtime(so) < os.path.getmtime(s)
+                       for s in srcs)):
             os.makedirs(_BUILD_DIR, exist_ok=True)
             tmp = so + ".tmp"
             subprocess.run(
                 ["g++", "-O3", "-march=native", "-shared", "-fPIC",
-                 "-o", tmp, src],
+                 "-o", tmp] + srcs,
                 check=True, capture_output=True, timeout=120)
             os.replace(tmp, so)
         lib = ctypes.CDLL(so)
@@ -42,6 +44,14 @@ def _build_and_load() -> ctypes.CDLL | None:
                                      ctypes.c_size_t, ctypes.c_size_t,
                                      ctypes.c_char_p]
         lib.hh256_chunks.restype = ctypes.c_size_t
+        lib.lzb_max_compressed.argtypes = [ctypes.c_size_t]
+        lib.lzb_max_compressed.restype = ctypes.c_size_t
+        lib.lzb_compress.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                     ctypes.c_char_p, ctypes.c_size_t]
+        lib.lzb_compress.restype = ctypes.c_long
+        lib.lzb_decompress.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                       ctypes.c_char_p, ctypes.c_size_t]
+        lib.lzb_decompress.restype = ctypes.c_long
         return lib
     except Exception:
         return None
@@ -80,3 +90,28 @@ def hh256_chunks_native(data: bytes, chunk_size: int,
     got = lib.hh256_chunks(key, bytes(data), len(data), chunk_size, out)
     assert got == n
     return [out.raw[i * 32:(i + 1) * 32] for i in range(n)]
+
+
+def lzb_compress_native(data: bytes) -> bytes | None:
+    """LZ-block compress; None when native lib unavailable OR the data
+    is incompressible (caller stores raw either way)."""
+    lib = get_lib()
+    if lib is None or len(data) == 0:
+        return None
+    cap = lib.lzb_max_compressed(len(data))
+    out = ctypes.create_string_buffer(cap)
+    got = lib.lzb_compress(bytes(data), len(data), out, cap)
+    if got <= 0:
+        return None
+    return out.raw[:got]
+
+
+def lzb_decompress_native(blob: bytes, out_size: int) -> bytes | None:
+    lib = get_lib()
+    if lib is None:
+        return None
+    out = ctypes.create_string_buffer(max(out_size, 1))
+    got = lib.lzb_decompress(bytes(blob), len(blob), out, out_size)
+    if got < 0:
+        raise ValueError("corrupt lzb block")
+    return out.raw[:got]

@@ -125,3 +125,14 @@ ERR_NO_SUCH_REPLICATION_CONFIG = _e(
 ERR_NO_SUCH_CORS_CONFIG = _e(
     "NoSuchCORSConfiguration",
     "The CORS configuration does not exist", 404)
+ERR_SSE_KEY_REQUIRED = _e(
+    "InvalidRequest",
+    "The object was stored using a form of Server Side Encryption. The "
+    "correct parameters must be provided to retrieve the object.", 400)
+ERR_SSE_KEY_MISMATCH = _e(
+    "AccessDenied",
+    "The calculated MD5 hash of the key did not match the hash that "
+    "was provided.", 403)
+ERR_INVALID_SSE_PARAMS = _e(
+    "InvalidArgument",
+    "Invalid server side encryption parameters", 400)

@@ -105,10 +105,15 @@ class S3ApiHandlers:
             from ..bucket.metadata import BucketMetadataSys
             bucket_meta = BucketMetadataSys.for_layer(layer)
         self.bucket_meta = bucket_meta
+        import os as _os
+        self.compress_enabled = _os.environ.get(
+            "MINIO_COMPRESS", "") == "on"
         if notifier is None:
             from ..event.notifier import NotificationSys
             notifier = NotificationSys(bucket_meta, region)
         self.notifier = notifier
+        from ..crypto.sse import LocalKMS
+        self.kms = LocalKMS.from_env()
 
     def _notify(self, event_name: str, bucket: str, key: str,
                 info: ObjectInfo | None = None,
@@ -249,7 +254,7 @@ class S3ApiHandlers:
             c.child("Key", info.name)
             c.child("LastModified", _iso8601(info.mod_time))
             c.child("ETag", f'"{info.etag}"')
-            c.child("Size", info.size)
+            c.child("Size", self._actual_size(info))
             c.child("StorageClass", "STANDARD")
         for cp in common:
             p = root.child("CommonPrefixes")
@@ -317,6 +322,180 @@ class S3ApiHandlers:
                 h[k] = v
         return h
 
+    # ---------------- compression plumbing ----------------
+
+    def _maybe_compress(self, key: str, body: bytes, meta: dict) -> bytes:
+        """Transparent compression before erasure coding when enabled
+        and the payload looks compressible (ref isCompressible gate +
+        newS2CompressReader wrap, cmd/object-api-utils.go:436,898)."""
+        from ..crypto import sse
+        from ..utils import compress
+        if not self.compress_enabled:
+            return body
+        if not compress.is_compressible(
+                key, meta.get("content-type", ""), len(body)):
+            return body
+        meta[compress.META_COMPRESSION] = compress.CODEC_TAG
+        meta[sse.META_ACTUAL_SIZE] = str(len(body))
+        return compress.compress_stream(body)
+
+    # ---------------- SSE plumbing ----------------
+
+    def _bucket_default_sse(self, bucket: str) -> bool:
+        """Bucket default encryption config requests SSE-S3 (ref
+        validateBucketSSEConfig + auto-encrypt on put)."""
+        raw = self.bucket_meta.get(bucket).sse_xml
+        return bool(raw) and "AES256" in raw
+
+    def _sse_mode_for_request(self, req: S3Request,
+                              ) -> tuple[str, bytes] | None:
+        """(mode, master-key) the request asks for, None = plain.
+        Single source of truth for both single-PUT and multipart."""
+        from ..crypto import sse
+        try:
+            ckey = sse.parse_ssec_key(req.headers)
+        except sse.SSEError:
+            raise s3err.ERR_INVALID_SSE_PARAMS
+        if ckey is not None:
+            return sse.SSE_C, ckey
+        if (req.headers.get(sse.H_SSE) == "AES256"
+                or self._bucket_default_sse(req.bucket)):
+            if not self.kms.configured:
+                # Never encrypt under an ephemeral master — the data
+                # would be unrecoverable after restart (the reference
+                # refuses SSE-S3 without a configured KMS).
+                raise s3err.ERR_INVALID_SSE_PARAMS
+            return sse.SSE_S3, self.kms.master
+        return None
+
+    def _sse_seal_into_meta(self, req: S3Request, mode: str,
+                            master: bytes, meta: dict) -> bytes:
+        """Create the object key, record the envelope; returns the key."""
+        from ..crypto import sse
+        okey = sse.new_object_key()
+        meta[sse.META_ALGORITHM] = mode
+        meta[sse.META_SEALED_KEY] = sse.seal_key(
+            master, okey, mode, req.bucket, req.key)
+        if mode == sse.SSE_C:
+            meta[sse.META_KEY_MD5] = req.headers[sse.H_SSEC_KEY_MD5]
+        else:
+            meta[sse.META_KMS_KEY_ID] = self.kms.key_id
+        return okey
+
+    def _sse_encrypt_body(self, req: S3Request, body: bytes,
+                          meta: dict) -> bytes:
+        """Encrypt an incoming object body when the request (or the
+        bucket default) asks for SSE; records the envelope in internal
+        metadata (ref EncryptRequest, cmd/encryption-v1.go:228)."""
+        from ..crypto import sse
+        picked = self._sse_mode_for_request(req)
+        if picked is None:
+            return body
+        okey = self._sse_seal_into_meta(req, *picked, meta)
+        # Compression may already have recorded the ORIGINAL length.
+        meta.setdefault(sse.META_ACTUAL_SIZE, str(len(body)))
+        return sse.encrypt_stream(body, okey)
+
+    def _sse_unseal_from_meta(self, req: S3Request, metadata: dict,
+                              bucket: str, key: str,
+                              copy_source: bool = False) -> bytes | None:
+        """Object key from an SSE envelope in metadata (validating
+        SSE-C credentials); None when not encrypted (ref
+        DecryptObjectInfo, cmd/encryption-v1.go:780)."""
+        from ..crypto import sse
+        mode = sse.is_encrypted(metadata)
+        if not mode:
+            return None
+        if mode == sse.SSE_C:
+            try:
+                ckey = sse.parse_ssec_key(req.headers, copy_source)
+            except sse.SSEError:
+                raise s3err.ERR_SSE_KEY_MISMATCH
+            if ckey is None:
+                raise s3err.ERR_SSE_KEY_REQUIRED
+            master = ckey
+        else:
+            master = self.kms.master
+        try:
+            return sse.unseal_key(master, metadata[sse.META_SEALED_KEY],
+                                  mode, bucket, key)
+        except sse.KeyMismatch:
+            raise s3err.ERR_SSE_KEY_MISMATCH
+
+    def _sse_unseal_for_read(self, req: S3Request, info: ObjectInfo,
+                             copy_source: bool = False) -> bytes | None:
+        return self._sse_unseal_from_meta(req, info.metadata,
+                                          info.bucket, info.name,
+                                          copy_source)
+
+    @staticmethod
+    def _sse_response_headers(info: ObjectInfo) -> dict:
+        from ..crypto import sse
+        mode = sse.is_encrypted(info.metadata)
+        if mode == sse.SSE_C:
+            return {sse.H_SSEC_ALGO: "AES256",
+                    sse.H_SSEC_KEY_MD5:
+                        info.metadata.get(sse.META_KEY_MD5, "")}
+        if mode == sse.SSE_S3:
+            return {sse.H_SSE: "AES256"}
+        return {}
+
+    @staticmethod
+    def _actual_size(info: ObjectInfo) -> int:
+        from ..crypto import sse
+        raw = info.metadata.get(sse.META_ACTUAL_SIZE)
+        return int(raw) if raw is not None else info.size
+
+    def _sse_decrypt_read(self, req: S3Request, info: ObjectInfo,
+                          okey: bytes, offset: int,
+                          length: int) -> bytes:
+        """Read [offset, offset+length) of the PLAINTEXT, touching only
+        the parts/packages that cover the range. Multipart ciphertexts
+        are per-part DARE streams (per-part derived keys) stitched by
+        part sizes (ref DecryptBlocksRequestR part-boundary walk,
+        cmd/encryption-v1.go:356)."""
+        from ..crypto import sse
+        version_id = self._version_param(req)
+        multipart = info.metadata.get(sse.META_SSE_MULTIPART) == "1"
+
+        def ranged_read(base_off, size_limit):
+            def read_fn(off, ln):
+                if off is None:
+                    return size_limit
+                data, _ = self.layer.get_object(
+                    info.bucket, info.name, offset=base_off + off,
+                    length=min(ln, size_limit - off),
+                    version_id=version_id)
+                return data
+            return read_fn
+
+        try:
+            if not multipart:
+                return sse.decrypt_range(ranged_read(0, info.size),
+                                         okey, offset, length)
+            # Walk parts by PLAINTEXT offsets; decrypt only coverers.
+            out = []
+            plain_pos = ct_pos = 0
+            want_end = offset + length
+            for p in info.parts:
+                plain_end = plain_pos + p.actual_size
+                if plain_end <= offset:
+                    plain_pos, ct_pos = plain_end, ct_pos + p.size
+                    continue
+                if plain_pos >= want_end:
+                    break
+                pkey = sse.derive_part_key(okey, p.number)
+                sub_off = max(0, offset - plain_pos)
+                sub_len = min(plain_end, want_end) - \
+                    (plain_pos + sub_off)
+                out.append(sse.decrypt_range(
+                    ranged_read(ct_pos, p.size), pkey, sub_off,
+                    sub_len))
+                plain_pos, ct_pos = plain_end, ct_pos + p.size
+            return b"".join(out)
+        except sse.SSEError:
+            raise s3err.ERR_INTERNAL_ERROR
+
     def put_object(self, req: S3Request) -> S3Response:
         if "x-amz-copy-source" in req.headers:
             return self.copy_object(req)
@@ -334,13 +513,16 @@ class S3ApiHandlers:
                 meta[k] = v
         if "x-amz-tagging" in req.headers:
             meta["x-amz-tagging"] = req.headers["x-amz-tagging"]
+        body = self._maybe_compress(req.key, req.body, meta)
+        body = self._sse_encrypt_body(req, body, meta)
         try:
             info = self.layer.put_object(
-                req.bucket, req.key, req.body, metadata=meta,
+                req.bucket, req.key, body, metadata=meta,
                 versioned=self._versioned(req.bucket))
         except BucketNotFound:
             raise s3err.ERR_NO_SUCH_BUCKET
         h = {"ETag": f'"{info.etag}"'}
+        h.update(self._sse_response_headers(info))
         if info.version_id:
             h["x-amz-version-id"] = info.version_id
         from ..event import event as ev
@@ -353,8 +535,19 @@ class S3ApiHandlers:
         if "/" not in src:
             raise s3err.ERR_INVALID_ARGUMENT
         sbucket, skey = src.split("/", 1)
+        from ..crypto import sse
+        from ..utils import compress
         try:
-            data, sinfo = self.layer.get_object(sbucket, skey)
+            sinfo = self.layer.get_object_info(sbucket, skey)
+            okey = self._sse_unseal_for_read(req, sinfo,
+                                             copy_source=True)
+            if okey is not None:
+                data = self._sse_decrypt_read(req, sinfo, okey, 0,
+                                              sinfo.size)
+            else:
+                data, sinfo = self.layer.get_object(sbucket, skey)
+            if sinfo.metadata.get(compress.META_COMPRESSION):
+                data = compress.decompress_stream(data)
         except (ObjectNotFound, BucketNotFound):
             raise s3err.ERR_NO_SUCH_KEY
         meta = dict(sinfo.metadata)
@@ -364,7 +557,15 @@ class S3ApiHandlers:
             for k, v in req.headers.items():
                 if k.startswith("x-amz-meta-"):
                     meta[k] = v
-        meta.pop("etag", None)
+        # The copy re-evaluates encryption/compression for the
+        # destination; the source's envelope must never leak across.
+        for k in (sse.META_ALGORITHM, sse.META_SEALED_KEY,
+                  sse.META_KEY_MD5, sse.META_KMS_KEY_ID,
+                  sse.META_ACTUAL_SIZE, compress.META_COMPRESSION,
+                  "etag"):
+            meta.pop(k, None)
+        data = self._maybe_compress(req.key, data, meta)
+        data = self._sse_encrypt_body(req, data, meta)
         info = self.layer.put_object(req.bucket, req.key, data,
                                      metadata=meta,
                                      versioned=self._versioned(req.bucket))
@@ -379,15 +580,39 @@ class S3ApiHandlers:
     def get_object(self, req: S3Request, head: bool = False) -> S3Response:
         version_id = self._version_param(req)
         try:
-            if head:
-                info = self.layer.get_object_info(req.bucket, req.key,
-                                                  version_id)
-                data = b""
-            else:
-                info = self.layer.get_object_info(req.bucket, req.key,
-                                                  version_id)
-                rng = _parse_range(req.headers.get("range", ""), info.size)
-                if rng is None:
+            from ..utils import compress
+            info = self.layer.get_object_info(req.bucket, req.key,
+                                              version_id)
+            okey = self._sse_unseal_for_read(req, info)
+            comp = info.metadata.get(compress.META_COMPRESSION)
+            # Ranges address the PLAINTEXT for transformed objects (ref
+            # DecryptObjectInfo size rewrite).
+            size = self._actual_size(info)
+            rng = _parse_range(req.headers.get("range", ""), size)
+            data = b""
+            if not head:
+                if comp:
+                    # SSE's inner plaintext IS the compressed stream;
+                    # its length <= stored size, so that bound reads all.
+                    if okey is not None:
+                        blob = self._sse_decrypt_read(req, info, okey,
+                                                      0, info.size)
+                    else:
+                        blob, _ = self.layer.get_object(
+                            req.bucket, req.key, version_id=version_id)
+                    try:
+                        if rng is None:
+                            data = compress.decompress_stream(blob)
+                        else:
+                            data = compress.decompress_range(
+                                blob, rng[0], rng[1])
+                    except ValueError:
+                        raise s3err.ERR_INTERNAL_ERROR
+                elif okey is not None:
+                    off, ln = rng if rng is not None else (0, size)
+                    data = self._sse_decrypt_read(req, info, okey,
+                                                  off, ln)
+                elif rng is None:
                     data, info = self.layer.get_object(
                         req.bucket, req.key, version_id=version_id)
                 else:
@@ -405,22 +630,50 @@ class S3ApiHandlers:
             raise s3err.ERR_NO_SUCH_KEY
 
         headers = self._object_headers(info)
+        headers.update(self._sse_response_headers(info))
         from ..event import event as ev
         self._notify(ev.OBJECT_ACCESSED_HEAD if head
                      else ev.OBJECT_ACCESSED_GET,
                      req.bucket, req.key, info)
         if head:
-            headers["Content-Length"] = str(info.size)
+            headers["Content-Length"] = str(size)
             return S3Response(200, b"", headers)
-        rng = _parse_range(req.headers.get("range", ""), info.size)
         if rng is not None:
             off, ln = rng
             headers["Content-Range"] = (
-                f"bytes {off}-{off + ln - 1}/{info.size}")
+                f"bytes {off}-{off + ln - 1}/{size}")
             return S3Response(206, data, headers)
         return S3Response(200, data, headers)
 
     # ---------------- multipart ----------------
+
+    def _sse_init_multipart(self, req: S3Request, meta: dict) -> None:
+        """Create the upload's SSE envelope at initiate time; each part
+        then encrypts under a key DERIVED from this object key by part
+        number (ref newMultipartUpload + DerivePartKey)."""
+        from ..crypto import sse
+        picked = self._sse_mode_for_request(req)
+        if picked is None:
+            return
+        self._sse_seal_into_meta(req, *picked, meta)
+        meta[sse.META_SSE_MULTIPART] = "1"
+
+    def _sse_part_key(self, req: S3Request,
+                      part_number: int) -> bytes | None:
+        """Per-part derived key for an encrypted upload; the per-part
+        request must carry SSE-C credentials again (ref PutObjectPart
+        SSE checks)."""
+        from ..crypto import sse
+        from ..erasure.multipart import UploadNotFound
+        try:
+            meta = self.layer.multipart.get_upload_meta(
+                req.bucket, req.key, req.params["uploadId"])
+        except UploadNotFound:
+            raise s3err.ERR_NO_SUCH_UPLOAD
+        okey = self._sse_unseal_from_meta(req, meta, req.bucket, req.key)
+        if okey is None:
+            return None
+        return sse.derive_part_key(okey, part_number)
 
     def initiate_multipart(self, req: S3Request) -> S3Response:
         from ..erasure.engine import BucketNotFound as BNF
@@ -429,6 +682,7 @@ class S3ApiHandlers:
         for k, v in req.headers.items():
             if k.startswith("x-amz-meta-"):
                 meta[k] = v
+        self._sse_init_multipart(req, meta)
         try:
             upload_id = self.layer.multipart.new_multipart_upload(
                 req.bucket, req.key, meta)
@@ -450,10 +704,18 @@ class S3ApiHandlers:
             if hashlib.md5(req.body).digest() != base64.b64decode(
                     md5_header):
                 raise s3err.ERR_BAD_DIGEST
+        body, actual = req.body, None
+        part_number = int(req.params["partNumber"])
+        pkey = self._sse_part_key(req, part_number)
+        if pkey is not None:
+            from ..crypto import sse
+            body = sse.encrypt_stream(req.body, pkey)
+            actual = len(req.body)
         try:
             part = self.layer.multipart.put_object_part(
                 req.bucket, req.key, req.params["uploadId"],
-                int(req.params["partNumber"]), req.body)
+                int(req.params["partNumber"]), body,
+                actual_size=actual)
         except UploadNotFound:
             raise s3err.ERR_NO_SUCH_UPLOAD
         except (InvalidPart, ValueError):
@@ -519,7 +781,8 @@ class S3ApiHandlers:
             e = root.child("Part")
             e.child("PartNumber", p["number"])
             e.child("ETag", f'"{p["etag"]}"')
-            e.child("Size", p["size"])
+            # Logical (pre-SSE/compression) size, as AWS reports.
+            e.child("Size", p.get("actualSize", p["size"]))
         return S3Response(200, root.tobytes(),
                           {"Content-Type": "application/xml"})
 
@@ -646,7 +909,7 @@ class S3ApiHandlers:
             e.child("LastModified", _iso8601(item.mod_time))
             if not item.delete_marker:
                 e.child("ETag", f'"{item.etag}"')
-                e.child("Size", item.size)
+                e.child("Size", self._actual_size(item))
                 e.child("StorageClass", "STANDARD")
         return S3Response(200, root.tobytes(),
                           {"Content-Type": "application/xml"})
@@ -1221,6 +1484,10 @@ class S3Server:
     @property
     def notifier(self):
         return self.handlers.notifier if self.handlers else None
+
+    @property
+    def kms(self):
+        return self.handlers.kms if self.handlers else None
 
     def stop(self) -> None:
         if self._httpd:

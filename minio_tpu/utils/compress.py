@@ -1,0 +1,129 @@
+"""Transparent object compression (ref cmd/object-api-utils.go:898
+newS2CompressReader + isCompressible:436 eligibility gate; the
+reference's S2 assembly codec maps to the native C++ LZ block codec in
+minio_tpu/native/lzblock.cc, with zlib as the no-compiler fallback).
+
+Framed stream of independently-coded blocks so reads can skip ahead:
+
+    b"MTZ1" then per block:
+      [1B flag: 0=raw 1=lzb 2=zlib][4B LE usize][4B LE csize][payload]
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+from ..native import lzb_compress_native, lzb_decompress_native
+
+MAGIC = b"MTZ1"
+BLOCK = 1024 * 1024
+F_RAW, F_LZB, F_ZLIB = 0, 1, 2
+
+META_COMPRESSION = "x-internal-compression"   # codec tag in xl.meta
+CODEC_TAG = "mtz/1"
+MIN_COMPRESS_SIZE = 4096
+
+# Content types that are already entropy-coded (ref excludedCompress
+# extensions/mime lists, cmd/object-api-utils.go:420-434).
+_INCOMPRESSIBLE_TYPES = (
+    "video/", "audio/", "image/",
+    "application/zip", "application/gzip", "application/x-gzip",
+    "application/x-bz2", "application/x-compress", "application/x-xz",
+    "application/x-7z-compressed", "application/zstd",
+)
+_INCOMPRESSIBLE_EXT = (
+    ".gz", ".bz2", ".xz", ".zst", ".zip", ".7z", ".rar",
+    ".mp4", ".mkv", ".mov", ".avi", ".mp3", ".aac", ".ogg",
+    ".jpg", ".jpeg", ".png", ".gif", ".webp",
+)
+
+
+def is_compressible(key: str, content_type: str, size: int) -> bool:
+    if size < MIN_COMPRESS_SIZE:
+        return False
+    ct = (content_type or "").lower()
+    for t in _INCOMPRESSIBLE_TYPES:
+        if ct.startswith(t):
+            return False
+    lk = key.lower()
+    return not any(lk.endswith(e) for e in _INCOMPRESSIBLE_EXT)
+
+
+def _compress_block(chunk: bytes) -> tuple[int, bytes]:
+    out = lzb_compress_native(chunk)
+    if out is not None:
+        return F_LZB, out
+    # No native lib: zlib level 1 keeps throughput reasonable.
+    z = zlib.compress(chunk, 1)
+    if len(z) < len(chunk):
+        return F_ZLIB, z
+    return F_RAW, chunk
+
+
+def compress_stream(data: bytes, block: int = BLOCK) -> bytes:
+    out = [MAGIC]
+    for i in range(0, max(len(data), 1), block):
+        chunk = data[i:i + block]
+        flag, payload = _compress_block(chunk)
+        out.append(struct.pack("<BII", flag, len(chunk), len(payload)))
+        out.append(payload)
+    return b"".join(out)
+
+
+def _iter_blocks(blob: bytes):
+    if blob[:4] != MAGIC:
+        raise ValueError("bad compression magic")
+    pos = 4
+    while pos < len(blob):
+        flag, usize, csize = struct.unpack_from("<BII", blob, pos)
+        pos += 9
+        payload = blob[pos:pos + csize]
+        if len(payload) != csize:
+            raise ValueError("truncated compressed stream")
+        pos += csize
+        yield flag, usize, payload
+
+
+def _expand(flag: int, usize: int, payload: bytes) -> bytes:
+    if flag == F_RAW:
+        return payload
+    if flag == F_LZB:
+        out = lzb_decompress_native(payload, usize)
+        if out is None:
+            raise ValueError("lzb block but native codec unavailable")
+        if len(out) != usize:
+            raise ValueError("lzb block size mismatch")
+        return out
+    if flag == F_ZLIB:
+        out = zlib.decompress(payload)
+        if len(out) != usize:
+            raise ValueError("zlib block size mismatch")
+        return out
+    raise ValueError(f"unknown block flag {flag}")
+
+
+def decompress_stream(blob: bytes) -> bytes:
+    return b"".join(_expand(f, u, p) for f, u, p in _iter_blocks(blob))
+
+
+def decompress_range(blob: bytes, offset: int, length: int) -> bytes:
+    """Decode only the blocks covering [offset, offset+length) — the
+    skip-to-offset read path (ref decompress w/ skip,
+    cmd/object-api-utils.go:665)."""
+    out = []
+    pos = 0
+    need_end = offset + length
+    for flag, usize, payload in _iter_blocks(blob):
+        if pos + usize <= offset:
+            pos += usize          # wholly before the range: skip decode
+            continue
+        out.append(_expand(flag, usize, payload))
+        pos += usize
+        if pos >= need_end:
+            break
+    joined = b"".join(out)
+    # First kept block starts at (pos of first kept block).
+    first_kept_start = pos - len(joined)
+    skip = offset - first_kept_start
+    return joined[skip:skip + length]
