@@ -33,7 +33,8 @@ def build_object_layer(disk_args: list[str],
                        block_size: int | None = None):
     """Construct the full topology: per-arg pools -> format.json
     bootstrap -> erasure sets -> server pools (ref newObjectLayer,
-    cmd/server-main.go:538)."""
+    cmd/server-main.go:538). A single plain path selects the FS
+    backend (ref NEndpoints==1 -> NewFSObjectLayer)."""
     import threading
 
     from .erasure.pools import ErasureServerPools
@@ -41,6 +42,12 @@ def build_object_layer(disk_args: list[str],
     from .storage.format import init_or_load_formats
     from .storage.xl import XLStorage
     from .utils.ellipses import expand, has_ellipses
+
+    if (len(disk_args) == 1 and not has_ellipses(disk_args[0])
+            and not disk_args[0].startswith(("http://", "https://"))):
+        from .fs.backend import FSObjects
+        os.makedirs(disk_args[0], exist_ok=True)
+        return FSObjects(disk_args[0])
 
     # Each ellipses arg is a pool; plain args group into one pool
     # (ref createServerEndpoints, cmd/endpoint-ellipses.go:252).
@@ -82,10 +89,13 @@ def build_object_layer(disk_args: list[str],
 
 
 def _make_iam(layer, access: str, secret: str):
-    """IAM persisted on the store's own first erasure set
-    (ref iam-object-store in .minio.sys)."""
+    """IAM persisted on the store's own first erasure set — or on the
+    single FS root (ref iam-object-store in .minio.sys)."""
     from .iam.iam import ConfigStore, IAMSys
-    disks = layer.pools[0].sets[0].disks
+    if hasattr(layer, "pools"):
+        disks = layer.pools[0].sets[0].disks
+    else:
+        disks = [layer.meta_disk]
     return IAMSys(ConfigStore(disks), access, secret)
 
 
@@ -128,12 +138,16 @@ def _serve(args) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 1
 
-    n_disks = sum(len(s.disks) for p in layer.pools for s in p.sets)
-    eng = layer.pools[0].sets[0]
-    print(f"minio-tpu server: {len(layer.pools)} pool(s), "
-          f"{sum(len(p.sets) for p in layer.pools)} set(s), "
-          f"{n_disks} disks, EC {eng.k}+{eng.m}, "
-          f"listening on {host}:{port}")
+    if hasattr(layer, "pools"):
+        n_disks = sum(len(s.disks) for p in layer.pools for s in p.sets)
+        eng = layer.pools[0].sets[0]
+        print(f"minio-tpu server: {len(layer.pools)} pool(s), "
+              f"{sum(len(p.sets) for p in layer.pools)} set(s), "
+              f"{n_disks} disks, EC {eng.k}+{eng.m}, "
+              f"listening on {host}:{port}")
+    else:
+        print(f"minio-tpu server: FS backend at {layer.root}, "
+              f"listening on {host}:{port}")
     print(f"   access key: {access}")
     sys.stdout.flush()
 

@@ -81,6 +81,8 @@ class BucketMetadataSys:
             disks = layer.pools[0].sets[0].disks
         elif hasattr(layer, "sets"):
             disks = layer.sets[0].disks
+        elif hasattr(layer, "meta_disk"):  # FS backend: single root
+            disks = [layer.meta_disk]
         else:
             disks = layer.disks
         return cls(ConfigStore(disks))

@@ -1,0 +1,3 @@
+from .backend import FSObjects
+
+__all__ = ["FSObjects"]

@@ -83,6 +83,10 @@ ERR_SLOW_DOWN = _e("SlowDown", "Please reduce your request rate", 503)
 ERR_NOT_IMPLEMENTED = _e("NotImplemented",
                          "A header you provided implies functionality "
                          "that is not implemented", 501)
+ERR_PARENT_IS_OBJECT = _e(
+    "XMinioParentIsObject",
+    "Object-prefix is already an object, please choose a different "
+    "object-prefix name.", 400)
 ERR_SIGNATURE_DOES_NOT_MATCH = _e(
     "SignatureDoesNotMatch",
     "The request signature we calculated does not match the signature "

@@ -19,6 +19,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..erasure.engine import (BucketExists, BucketNotFound, ErasureObjects,
                               MethodNotAllowed, ObjectInfo, ObjectNotFound)
+from ..fs.backend import ParentIsObject
 from ..parallel.quorum import QuorumError
 from . import errors as s3err
 from . import sigv4
@@ -521,6 +522,10 @@ class S3ApiHandlers:
                 versioned=self._versioned(req.bucket))
         except BucketNotFound:
             raise s3err.ERR_NO_SUCH_BUCKET
+        except MethodNotAllowed:
+            raise s3err.ERR_NOT_IMPLEMENTED
+        except ParentIsObject:
+            raise s3err.ERR_PARENT_IS_OBJECT
         h = {"ETag": f'"{info.etag}"'}
         h.update(self._sse_response_headers(info))
         if info.version_id:
@@ -743,6 +748,8 @@ class S3ApiHandlers:
             if "ascending" in str(e):
                 raise s3err.ERR_INVALID_PART_ORDER
             raise s3err.ERR_INVALID_PART
+        except ParentIsObject:
+            raise s3err.ERR_PARENT_IS_OBJECT
         root = Element("CompleteMultipartUploadResult", S3_XMLNS)
         root.child("Location",
                    f"http://{req.headers.get('host', '')}"
@@ -824,6 +831,9 @@ class S3ApiHandlers:
         status = doc.findtext("Status") or ""
         if status not in ("Enabled", "Suspended"):
             raise s3err.ERR_MALFORMED_XML
+        if not getattr(self.layer, "supports_versioning", True):
+            # ref FS backend: versioning APIs -> NotImplemented
+            raise s3err.ERR_NOT_IMPLEMENTED
         self.bucket_meta.update(req.bucket, versioning=status)
         return S3Response(200)
 
@@ -839,8 +849,11 @@ class S3ApiHandlers:
         vid_marker = req.params.get("version-id-marker", "")
         max_keys = min(int(req.params.get("max-keys", "1000") or "1000"),
                        1000)
-        infos = self.layer.list_object_versions(req.bucket, prefix=prefix,
-                                                max_keys=1_000_000)
+        try:
+            infos = self.layer.list_object_versions(
+                req.bucket, prefix=prefix, max_keys=1_000_000)
+        except MethodNotAllowed:
+            raise s3err.ERR_NOT_IMPLEMENTED  # FS backend (ref fs-v1.go:1444)
         # Build the flat entry stream first: delimiter collapse, latest
         # flags; then cut one page out of it.
         latest_seen: set[str] = set()
@@ -1079,6 +1092,8 @@ class S3ApiHandlers:
         except (ObjectNotFound, BucketNotFound):
             if version_id:  # S3 DELETE is idempotent-success on missing keys
                 h["x-amz-version-id"] = version_id
+        except MethodNotAllowed:
+            raise s3err.ERR_NOT_IMPLEMENTED  # FS backend versioned delete
         return S3Response(204, headers=h)
 
 

@@ -22,7 +22,7 @@ import time
 
 from ..bucket.lifecycle import (DELETE, DELETE_MARKER, DELETE_VERSION,
                                 Lifecycle, parse_tags)
-from ..erasure.engine import ObjectNotFound
+from ..erasure.engine import MethodNotAllowed, ObjectNotFound
 
 USAGE_PATH = "data-usage/usage.json"
 
@@ -90,11 +90,20 @@ class DataCrawler:
             versioned = meta.versioning_enabled()
             bu = {"objects": 0, "versions": 0, "size": 0,
                   "histogram": {}}
+            versions = None
             try:
                 versions = self.layer.list_object_versions(
                     bucket, max_keys=1_000_000)
+            except MethodNotAllowed:
+                pass  # FS backend has no version index
             except Exception:
                 continue
+            if versions is None:
+                try:
+                    versions = self.layer.list_objects(
+                        bucket, max_keys=1_000_000)
+                except Exception:
+                    continue
             # Group per key, newest first (list order guarantees this).
             per_key: dict[str, list] = {}
             for v in versions:

@@ -1,0 +1,221 @@
+"""FS backend tests + shared dual-backend behavior suite.
+
+The parametrized `layer` fixture runs one suite against BOTH the FS and
+erasure backends — the reference's ExecObjectLayerTest pattern
+(cmd/test-utils_test.go:1892 runs each test body on FS and Erasure)."""
+
+import os
+
+import pytest
+
+from minio_tpu.erasure.engine import (BucketExists, BucketNotFound,
+                                      ErasureObjects, MethodNotAllowed,
+                                      ObjectNotFound)
+from minio_tpu.erasure.multipart import PartTooSmall, UploadNotFound
+from minio_tpu.fs.backend import FSObjects
+from minio_tpu.storage.xl import XLStorage
+
+
+@pytest.fixture(params=["fs", "erasure"])
+def layer(request, tmp_path):
+    if request.param == "fs":
+        return FSObjects(str(tmp_path / "fsroot"))
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    return ErasureObjects(disks)
+
+
+class TestSharedBehavior:
+    def test_bucket_lifecycle(self, layer):
+        layer.make_bucket("b1")
+        assert layer.bucket_exists("b1")
+        with pytest.raises(BucketExists):
+            layer.make_bucket("b1")
+        assert [b["name"] for b in layer.list_buckets()] == ["b1"]
+        layer.delete_bucket("b1")
+        assert not layer.bucket_exists("b1")
+        with pytest.raises(BucketNotFound):
+            layer.delete_bucket("b1")
+
+    def test_put_get_roundtrip(self, layer):
+        layer.make_bucket("b")
+        data = os.urandom(100_000)
+        info = layer.put_object("b", "dir/obj.bin", data,
+                                metadata={"content-type": "application/x"})
+        assert info.size == len(data)
+        got, gi = layer.get_object("b", "dir/obj.bin")
+        assert got == data
+        assert gi.etag == info.etag
+        assert gi.metadata.get("content-type") == "application/x"
+
+    def test_range_reads(self, layer):
+        layer.make_bucket("b")
+        data = bytes(range(256)) * 100
+        layer.put_object("b", "o", data)
+        for off, ln in [(0, 10), (100, 1000), (25599, 1), (25000, -1)]:
+            got, _ = layer.get_object("b", "o", offset=off, length=ln)
+            want = data[off:] if ln < 0 else data[off:off + ln]
+            assert got == want
+        with pytest.raises(ValueError):
+            layer.get_object("b", "o", offset=len(data) + 1)
+
+    def test_empty_object(self, layer):
+        layer.make_bucket("b")
+        layer.put_object("b", "empty", b"")
+        got, info = layer.get_object("b", "empty")
+        assert got == b"" and info.size == 0
+
+    def test_overwrite(self, layer):
+        layer.make_bucket("b")
+        layer.put_object("b", "o", b"v1")
+        layer.put_object("b", "o", b"version-two")
+        got, info = layer.get_object("b", "o")
+        assert got == b"version-two" and info.size == 11
+
+    def test_delete(self, layer):
+        layer.make_bucket("b")
+        layer.put_object("b", "o", b"x")
+        layer.delete_object("b", "o")
+        assert not layer.object_exists("b", "o")
+        with pytest.raises(ObjectNotFound):
+            layer.get_object("b", "o")
+
+    def test_list_objects(self, layer):
+        layer.make_bucket("b")
+        for name in ["a/1", "a/2", "b/1", "top"]:
+            layer.put_object("b", name, b"x")
+        names = [o.name for o in layer.list_objects("b")]
+        assert names == ["a/1", "a/2", "b/1", "top"]
+        names = [o.name for o in layer.list_objects("b", prefix="a/")]
+        assert names == ["a/1", "a/2"]
+
+    def test_multipart(self, layer):
+        layer.make_bucket("b")
+        mp = layer.multipart
+        uid = mp.new_multipart_upload("b", "big", {"k": "v"})
+        p1 = b"A" * (5 * 1024 * 1024)
+        p2 = b"B" * 1024
+        e1 = mp.put_object_part("b", "big", uid, 1, p1)["etag"]
+        e2 = mp.put_object_part("b", "big", uid, 2, p2)["etag"]
+        info = mp.complete_multipart_upload("b", "big", uid,
+                                            [(1, e1), (2, e2)])
+        assert info.size == len(p1) + len(p2)
+        assert info.etag.endswith("-2")
+        got, _ = layer.get_object("b", "big")
+        assert got == p1 + p2
+        # ranged read across the part boundary
+        got, _ = layer.get_object("b", "big", offset=len(p1) - 2, length=4)
+        assert got == b"AABB"
+
+    def test_multipart_part_too_small(self, layer):
+        layer.make_bucket("b")
+        mp = layer.multipart
+        uid = mp.new_multipart_upload("b", "o")
+        e1 = mp.put_object_part("b", "o", uid, 1, b"tiny")["etag"]
+        e2 = mp.put_object_part("b", "o", uid, 2, b"tiny2")["etag"]
+        with pytest.raises(PartTooSmall):
+            mp.complete_multipart_upload("b", "o", uid, [(1, e1), (2, e2)])
+
+    def test_multipart_abort(self, layer):
+        layer.make_bucket("b")
+        mp = layer.multipart
+        uid = mp.new_multipart_upload("b", "o")
+        mp.put_object_part("b", "o", uid, 1, b"x")
+        mp.abort_multipart_upload("b", "o", uid)
+        with pytest.raises(UploadNotFound):
+            mp.list_parts("b", "o", uid)
+        assert mp.list_uploads("b") == []
+
+    def test_tags(self, layer):
+        layer.make_bucket("b")
+        layer.put_object("b", "o", b"x")
+        layer.put_object_tags("b", "o", "k1=v1&k2=v2")
+        info = layer.get_object_info("b", "o")
+        assert info.metadata.get("x-amz-tagging") == "k1=v1&k2=v2"
+
+
+class TestFSSpecific:
+    def test_versioning_not_supported(self, tmp_path):
+        fs = FSObjects(str(tmp_path))
+        fs.make_bucket("b")
+        with pytest.raises(MethodNotAllowed):
+            fs.put_object("b", "o", b"x", versioned=True)
+        with pytest.raises(MethodNotAllowed):
+            fs.list_object_versions("b")
+
+    def test_atomic_overwrite_keeps_meta_dir_clean(self, tmp_path):
+        fs = FSObjects(str(tmp_path))
+        fs.make_bucket("b")
+        fs.put_object("b", "x/y/z", b"1")
+        fs.delete_object("b", "x/y/z")
+        # intermediate dirs pruned
+        assert not os.path.exists(os.path.join(str(tmp_path), "b", "x"))
+
+    def test_heal_is_noop(self, tmp_path):
+        fs = FSObjects(str(tmp_path))
+        fs.make_bucket("b")
+        fs.put_object("b", "o", b"x")
+        assert fs.healer.heal_all() == []
+
+    def test_parent_child_key_conflicts(self, tmp_path):
+        from minio_tpu.fs.backend import ParentIsObject
+        fs = FSObjects(str(tmp_path))
+        fs.make_bucket("b")
+        fs.put_object("b", "a", b"file")
+        with pytest.raises(ParentIsObject):
+            fs.put_object("b", "a/b", b"child under file")
+        fs.put_object("b", "d/e", b"nested")
+        with pytest.raises(ParentIsObject):
+            fs.put_object("b", "d", b"file over prefix")
+
+    def test_meta_survives_for_out_of_band_files(self, tmp_path):
+        fs = FSObjects(str(tmp_path))
+        fs.make_bucket("b")
+        # file dropped directly on disk (ref defaultFsJSON fallback)
+        with open(os.path.join(str(tmp_path), "b", "raw"), "wb") as f:
+            f.write(b"outofband")
+        info = fs.get_object_info("b", "raw")
+        assert info.size == 9
+        got, _ = fs.get_object("b", "raw")
+        assert got == b"outofband"
+
+
+def test_fs_behind_s3_server(tmp_path):
+    """Full HTTP S3 API over the FS backend (ref server_test.go runs
+    the API suite against FS too)."""
+    from minio_tpu.s3.client import S3Client
+    from minio_tpu.s3.server import S3Server
+
+    fs = FSObjects(str(tmp_path / "fsroot"))
+    srv = S3Server(fs, "fsaccess", "fssecret")
+    port = srv.start()
+    try:
+        c = S3Client("127.0.0.1", port, "fsaccess", "fssecret")
+        assert c.make_bucket("fsbucket").status == 200
+        r = c.put_object("fsbucket", "hello.txt", b"hi fs")
+        assert r.status == 200
+        r = c.get_object("fsbucket", "hello.txt")
+        assert r.status == 200 and r.body == b"hi fs"
+        r = c.request("GET", "/fsbucket", query="list-type=2")
+        assert r.status == 200 and b"hello.txt" in r.body
+        # versioning APIs are NotImplemented on FS (ref fs-v1.go)
+        ver_xml = (b'<VersioningConfiguration>'
+                   b'<Status>Enabled</Status></VersioningConfiguration>')
+        r = c.request("PUT", "/fsbucket", query="versioning", body=ver_xml)
+        assert r.status == 501
+        r = c.request("GET", "/fsbucket", query="versions")
+        assert r.status == 501
+        # parent/child key conflict -> 400, not 500
+        r = c.put_object("fsbucket", "hello.txt/sub", b"x")
+        assert r.status == 400 and b"XMinioParentIsObject" in r.body
+        assert c.request("DELETE", "/fsbucket/hello.txt").status == 204
+    finally:
+        srv.stop()
+
+
+def test_cli_builds_fs_layer(tmp_path):
+    from minio_tpu.__main__ import build_object_layer
+    layer = build_object_layer([str(tmp_path / "single")])
+    assert isinstance(layer, FSObjects)
+    layer.make_bucket("b")
+    layer.put_object("b", "o", b"data")
+    assert layer.get_object("b", "o")[0] == b"data"
