@@ -35,13 +35,12 @@ from ..utils import ceil_frac
 from . import bitrot
 from .codec import BLOCK_SIZE, Erasure
 
-_UUID_RE = __import__("re").compile(
-    r"^[0-9a-f]{8}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{12}$")
+from ..storage.interface import DATA_DIR_RE
 
 
 def _looks_like_data_dir(name: str) -> bool:
     """Data dirs are uuid4 names (metadata.new_data_dir)."""
-    return bool(_UUID_RE.match(name))
+    return bool(DATA_DIR_RE.match(name))
 
 
 class ObjectNotFound(Exception):
@@ -115,6 +114,15 @@ class ErasureObjects:
         # Namespace locks: in-process by default; distributed deployments
         # inject a dsync-backed provider (ref ObjectLayer.NewNSLock).
         self.ns_lock = LocalNSLock()
+        # Listing engine + change tracking (ref metacache + bloom
+        # dataUpdateTracker; cmd/metacache-server-pool.go:38).
+        from ..listing.metacache import MetacacheManager
+        from ..scanner.tracker import DataUpdateTracker
+        self.update_tracker = DataUpdateTracker()
+        self.metacache = MetacacheManager(self)
+
+    def _mark_update(self, bucket: str, object_name: str = "") -> None:
+        self.update_tracker.mark(bucket, object_name)
 
     # ------------------------------------------------------------------
     # buckets
@@ -148,6 +156,8 @@ class ErasureObjects:
         if all(isinstance(e, serr.VolumeNotFound) for e in errs):
             raise BucketNotFound(bucket)
         reduce_quorum_errs(errs, len(self.disks) // 2 + 1, "delete_bucket")
+        self.metacache.drop_bucket(bucket)
+        self._mark_update(bucket)
 
     def list_buckets(self) -> list[dict]:
         for disk in self.disks:
@@ -252,6 +262,7 @@ class ErasureObjects:
             # Partial failure feeds the MRF heal queue (ref addPartial,
             # cmd/erasure-object.go:1082).
             self.mrf.add(bucket, object_name)
+        self._mark_update(bucket, object_name)
         return ObjectInfo(bucket=bucket, name=object_name, size=len(data),
                           etag=etag, mod_time=mod_time,
                           version_id=version_id, metadata=meta,
@@ -540,6 +551,7 @@ class ErasureObjects:
                      for d in self.disks])
                 reduce_quorum_errs(errs, write_quorum(self.k, self.m),
                                    "delete_object(marker)")
+            self._mark_update(bucket, object_name)
             return ObjectInfo(bucket=bucket, name=object_name,
                               version_id=marker.version_id,
                               delete_marker=True,
@@ -568,6 +580,7 @@ class ErasureObjects:
                                     serr.VersionNotFound)) else e
              for e in errs],
             write_quorum(self.k, self.m), "delete_object")
+        self._mark_update(bucket, object_name)
         return ObjectInfo(bucket=bucket, name=object_name,
                           version_id=version_id,
                           delete_marker=was_marker)
@@ -614,6 +627,7 @@ class ErasureObjects:
                  for i in range(len(self.disks))])
             reduce_quorum_errs(errs, write_quorum(self.k, self.m),
                                "put_object_tags")
+        self._mark_update(bucket, object_name)
 
     def walk_object_names(self, bucket: str) -> list[str]:
         """Union-merge directory walk across disks: every object name
@@ -649,50 +663,25 @@ class ErasureObjects:
         return sorted(n.rstrip("/") for n in names)
 
     def list_objects(self, bucket: str, prefix: str = "",
-                     max_keys: int = 1000) -> list[ObjectInfo]:
-        """Walk + quorum-stat each object (the metacache engine replaces
-        this for scale)."""
+                     max_keys: int = 1000,
+                     marker: str = "") -> list[ObjectInfo]:
+        """Latest live version per key, served by the metacache engine:
+        cached parallel walk_dir + k-way quorum merge (ref listPath,
+        cmd/metacache-server-pool.go:38)."""
         self._check_bucket(bucket)
-        out = []
-        for name in self.walk_object_names(bucket):
-            if prefix and not name.startswith(prefix):
-                continue
-            try:
-                out.append(self.get_object_info(bucket, name))
-            except (ObjectNotFound, MethodNotAllowed, QuorumError):
-                continue
-            if len(out) >= max_keys:
-                break
-        return out
+        return [ObjectInfo.from_file_info(fi)
+                for fi in self.metacache.list_path(
+                    bucket, prefix=prefix, marker=marker,
+                    max_keys=max_keys)]
 
     def list_object_versions(self, bucket: str, prefix: str = "",
-                             max_keys: int = 1000) -> list[ObjectInfo]:
-        """All versions (objects + delete markers) newest-first per key
-        (ref ListObjectVersions via the same metadata walk). A version
-        counts when >= read-quorum disks agree on it."""
+                             max_keys: int = 1000,
+                             marker: str = "") -> list[ObjectInfo]:
+        """All versions (objects + delete markers) newest-first per key,
+        quorum-resolved from the same metacache walk (ref
+        ListObjectVersions through listPath)."""
         self._check_bucket(bucket)
-        rq = read_quorum(self.k)
-        out: list[ObjectInfo] = []
-        for name in self.walk_object_names(bucket):
-            if prefix and not name.startswith(prefix):
-                continue
-            results, _ = parallel_map(
-                [lambda d=d: d.read_versions(bucket, name)
-                 for d in self.disks])
-            counts: dict[tuple, int] = {}
-            fis: dict[tuple, FileInfo] = {}
-            for r in results:
-                if r is None or isinstance(r, BaseException):
-                    continue
-                for fi in r:
-                    key = fi.quorum_key()
-                    counts[key] = counts.get(key, 0) + 1
-                    fis[key] = fi
-            versions = sorted(
-                (fi for key, fi in fis.items() if counts[key] >= rq),
-                key=lambda fi: (-fi.mod_time, fi.version_id))
-            out.extend(ObjectInfo.from_file_info(fi) for fi in versions)
-            if len(out) >= max_keys:
-                out = out[:max_keys]
-                break
-        return out
+        return [ObjectInfo.from_file_info(fi)
+                for fi in self.metacache.list_versions(
+                    bucket, prefix=prefix, marker=marker,
+                    max_keys=max_keys)]

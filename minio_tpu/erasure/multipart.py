@@ -309,6 +309,7 @@ class MultipartUploads:
         if any(e is not None for e in errs):
             eng.mrf.add(bucket, object_name)
         self._cleanup(bucket, object_name, upload_id)
+        eng._mark_update(bucket, object_name)
 
         from .engine import ObjectInfo
         return ObjectInfo(bucket=bucket, name=object_name,

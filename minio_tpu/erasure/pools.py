@@ -135,10 +135,12 @@ class ErasureServerPools:
                                bucket, object_name, tags, version_id))
 
     def list_object_versions(self, bucket: str, prefix: str = "",
-                             max_keys: int = 1000) -> list[ObjectInfo]:
+                             max_keys: int = 1000,
+                             marker: str = "") -> list[ObjectInfo]:
         per_pool, _ = parallel_map(
             [lambda p=p: p.list_object_versions(bucket, prefix=prefix,
-                                                max_keys=max_keys)
+                                                max_keys=max_keys,
+                                                marker=marker)
              for p in self.pools])
         merged: list[ObjectInfo] = []
         seen: set[tuple] = set()
@@ -152,10 +154,11 @@ class ErasureServerPools:
         return merged[:max_keys]
 
     def list_objects(self, bucket: str, prefix: str = "",
-                     max_keys: int = 1000) -> list[ObjectInfo]:
+                     max_keys: int = 1000,
+                     marker: str = "") -> list[ObjectInfo]:
         per_pool, _ = parallel_map(
             [lambda p=p: p.list_objects(bucket, prefix=prefix,
-                                        max_keys=max_keys)
+                                        max_keys=max_keys, marker=marker)
              for p in self.pools])
         merged: list[ObjectInfo] = []
         seen: set[str] = set()

@@ -111,10 +111,12 @@ class ErasureSets:
             bucket, object_name, tags, version_id)
 
     def list_object_versions(self, bucket: str, prefix: str = "",
-                             max_keys: int = 1000) -> list[ObjectInfo]:
+                             max_keys: int = 1000,
+                             marker: str = "") -> list[ObjectInfo]:
         per_set, _ = parallel_map(
             [lambda s=s: s.list_object_versions(bucket, prefix=prefix,
-                                                max_keys=max_keys)
+                                                max_keys=max_keys,
+                                                marker=marker)
              for s in self.sets])
         merged: list[ObjectInfo] = []
         for lst in per_set:
@@ -124,11 +126,12 @@ class ErasureSets:
         return merged[:max_keys]
 
     def list_objects(self, bucket: str, prefix: str = "",
-                     max_keys: int = 1000) -> list[ObjectInfo]:
+                     max_keys: int = 1000,
+                     marker: str = "") -> list[ObjectInfo]:
         """Merge sorted per-set listings."""
         per_set, _ = parallel_map(
             [lambda s=s: s.list_objects(bucket, prefix=prefix,
-                                        max_keys=max_keys)
+                                        max_keys=max_keys, marker=marker)
              for s in self.sets])
         merged: list[ObjectInfo] = []
         for lst in per_set:

@@ -285,10 +285,13 @@ class FSObjects:
         return names
 
     def list_objects(self, bucket: str, prefix: str = "",
-                     max_keys: int = 1000) -> list[ObjectInfo]:
+                     max_keys: int = 1000,
+                     marker: str = "") -> list[ObjectInfo]:
         out = []
         for name in self.walk_object_names(bucket):
             if prefix and not name.startswith(prefix):
+                continue
+            if marker and name <= marker:
                 continue
             try:
                 out.append(self.get_object_info(bucket, name))
@@ -299,7 +302,8 @@ class FSObjects:
         return out
 
     def list_object_versions(self, bucket: str, prefix: str = "",
-                             max_keys: int = 1000) -> list[ObjectInfo]:
+                             max_keys: int = 1000,
+                             marker: str = "") -> list[ObjectInfo]:
         # ref cmd/fs-v1.go:1444: NotImplemented
         raise MethodNotAllowed("FS backend does not support versioning")
 

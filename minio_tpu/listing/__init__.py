@@ -1,0 +1,3 @@
+from .metacache import MetacacheManager
+
+__all__ = ["MetacacheManager"]

@@ -89,6 +89,12 @@ class StorageRPCService:
         return {"entries": self._disk(a).list_dir(a["volume"],
                                                   a["path"])}, b""
 
+    def rpc_walk_dir(self, a, p):
+        # One RPC per disk per listing scan (ref WalkDir streamed over
+        # storage REST, cmd/metacache-walk.go).
+        return {"entries": self._disk(a).walk_dir(
+            a["volume"], a.get("prefix", ""))}, b""
+
     def rpc_rename_data(self, a, p):
         self._disk(a).rename_data(a["src_volume"], a["src_path"],
                                   _fi_from_wire(a["fi"]),
@@ -192,6 +198,10 @@ class RemoteStorage(StorageAPI):
     def list_dir(self, volume, path):
         return self._call("list_dir", {"volume": volume,
                                        "path": path})[0]["entries"]
+
+    def walk_dir(self, volume, prefix=""):
+        return self._call("walk_dir", {"volume": volume,
+                                       "prefix": prefix})[0]["entries"]
 
     def rename_data(self, src_volume, src_path, fi, dst_volume, dst_path):
         self._call("rename_data", {"src_volume": src_volume,

@@ -63,6 +63,29 @@ class DataCrawler:
         self.last_usage: dict = self._load_usage()
         self.cycles = 0
         self.healed: list[tuple[str, str]] = []
+        # Change-tracking skip state (ref dataUpdateTracker bloom skip
+        # of unchanged subtrees; full sweep every N cycles).
+        self._last_counters: dict[str, int] = {}
+        self.full_cycle_every = 16
+        self.skipped_buckets = 0
+
+    def _engines(self):
+        layer = self.layer
+        if hasattr(layer, "pools"):
+            return [s for p in layer.pools for s in p.sets]
+        if hasattr(layer, "sets"):
+            return list(layer.sets)
+        return [layer]
+
+    def _bucket_counter(self, bucket: str) -> int | None:
+        """Sum of change counters across engines; None when NO engine
+        has a tracker (FS backend) — callers must then never skip."""
+        total = None
+        for eng in self._engines():
+            t = getattr(eng, "update_tracker", None)
+            if t is not None:
+                total = (total or 0) + t.bucket_counter(bucket)
+        return total
 
     # -- persistence ----------------------------------------------------
 
@@ -83,11 +106,25 @@ class DataCrawler:
     def crawl_once(self, now: float | None = None) -> dict:
         now = time.time() if now is None else now
         usage: dict = {"lastUpdate": now, "buckets": {}}
+        full_sweep = (self.cycles % self.full_cycle_every == 0)
         for b in self.layer.list_buckets():
             bucket = b["name"]
             meta = self.bucket_meta.get(bucket)
             lc = Lifecycle.parse(meta.lifecycle_xml)
             versioned = meta.versioning_enabled()
+            # Unchanged since last cycle + no time-driven lifecycle
+            # rules -> keep previous usage, skip the walk (ref bloom
+            # skip; lifecycle actions are time-based so those buckets
+            # always rescan, as does every Nth full sweep).
+            counter = self._bucket_counter(bucket)
+            prev = self.last_usage.get("buckets", {}).get(bucket)
+            if (not full_sweep and not lc and prev is not None
+                    and counter is not None
+                    and self._last_counters.get(bucket) == counter):
+                usage["buckets"][bucket] = prev
+                self.skipped_buckets += 1
+                continue
+            self._last_counters[bucket] = counter
             bu = {"objects": 0, "versions": 0, "size": 0,
                   "histogram": {}}
             versions = None
@@ -132,6 +169,13 @@ class DataCrawler:
             self.last_usage = usage
             self.cycles += 1
         self._save_usage(usage)
+        # Cycle the per-engine change blooms + persist advisory tracker
+        # state (ref CycleBloom fan-out; tracker saved per disk).
+        for i, eng in enumerate(self._engines()):
+            t = getattr(eng, "update_tracker", None)
+            if t is not None:
+                t.advance_cycle()
+                t.save(self.store, f"tracker/state-{i}.json")
         return usage
 
     def _apply_lifecycle(self, bucket: str, key: str, vers: list,

@@ -12,8 +12,15 @@ layer. Errors are storage.errors types.
 from __future__ import annotations
 
 import abc
+import re
 
 from .metadata import FileInfo
+
+# Version data dirs are uuid4 names (metadata.new_data_dir); the walk
+# must not descend into them as if they were key prefixes.
+DATA_DIR_RE = re.compile(
+    r"^[0-9a-f]{8}-[0-9a-f]{4}-[0-9a-f]{4}-"
+    r"[0-9a-f]{4}-[0-9a-f]{12}$")
 
 
 class StorageAPI(abc.ABC):
@@ -76,6 +83,48 @@ class StorageAPI(abc.ABC):
     @abc.abstractmethod
     def list_dir(self, volume: str, path: str) -> list[str]:
         """Entries of a directory; dirs have a trailing '/'."""
+
+    def walk_dir(self, volume: str, prefix: str = "") -> list[dict]:
+        """Stream this disk's view of a bucket, sorted by object name:
+        [{"name": ..., "versions": [version-dict, ...]}, ...]
+        (ref StorageAPI.WalkDir, cmd/metacache-walk.go — the per-disk
+        feeder of the metacache listing engine). Entries carry the full
+        xl.meta versions array so the merger can resolve quorum without
+        extra round trips. Remote disks override with a single RPC.
+        """
+        from . import errors as _serr
+        out: list[dict] = []
+
+        def rec(path: str) -> None:
+            try:
+                entries = self.list_dir(volume, path)
+            except _serr.StorageError:
+                return
+            is_obj = "xl.meta" in entries
+            if is_obj and (not prefix or path.startswith(prefix)):
+                try:
+                    vers = [fi.to_version_dict()
+                            for fi in self.read_versions(volume, path)]
+                    out.append({"name": path, "versions": vers})
+                except _serr.StorageError:
+                    pass
+            for e in entries:
+                if not e.endswith("/"):
+                    continue
+                name = e[:-1]
+                if is_obj and DATA_DIR_RE.match(name):
+                    continue  # version data dir, not a key prefix
+                sub = f"{path}/{name}" if path else name
+                # Prefix pruning: descend only when sub can still hold
+                # matches (sub itself matches, or prefix lies below sub).
+                if prefix and not (sub.startswith(prefix)
+                                   or prefix.startswith(sub + "/")):
+                    continue
+                rec(sub)
+
+        rec("")
+        out.sort(key=lambda d: d["name"])
+        return out
 
     # --- object versions (xl.meta) ---
 
