@@ -543,16 +543,8 @@ class S3ApiHandlers:
         from ..crypto import sse
         from ..utils import compress
         try:
-            sinfo = self.layer.get_object_info(sbucket, skey)
-            okey = self._sse_unseal_for_read(req, sinfo,
-                                             copy_source=True)
-            if okey is not None:
-                data = self._sse_decrypt_read(req, sinfo, okey, 0,
-                                              sinfo.size)
-            else:
-                data, sinfo = self.layer.get_object(sbucket, skey)
-            if sinfo.metadata.get(compress.META_COMPRESSION):
-                data = compress.decompress_stream(data)
+            data, sinfo = self._read_object_plain(
+                req, bucket=sbucket, key=skey, copy_source=True)
         except (ObjectNotFound, BucketNotFound):
             raise s3err.ERR_NO_SUCH_KEY
         meta = dict(sinfo.metadata)
@@ -581,6 +573,58 @@ class S3ApiHandlers:
         self._notify(ev.OBJECT_CREATED_COPY, req.bucket, req.key, info)
         return S3Response(200, root.tobytes(),
                           {"Content-Type": "application/xml"})
+
+    def _read_object_plain(self, req: S3Request, version_id: str = "",
+                           bucket: str | None = None,
+                           key: str | None = None,
+                           copy_source: bool = False,
+                           ) -> tuple[bytes, "ObjectInfo"]:
+        """Full object bytes after SSE decrypt + decompression — the
+        shared tail of CopyObject's source read and SELECT's scan (ref
+        the GetObjectNInfo pipeline both reuse)."""
+        from ..utils import compress
+        bucket = req.bucket if bucket is None else bucket
+        key = req.key if key is None else key
+        info = self.layer.get_object_info(bucket, key, version_id)
+        okey = self._sse_unseal_for_read(req, info,
+                                         copy_source=copy_source)
+        if okey is not None:
+            data = self._sse_decrypt_read(req, info, okey, 0, info.size)
+        else:
+            data, info = self.layer.get_object(bucket, key,
+                                               version_id=version_id)
+        if info.metadata.get(compress.META_COMPRESSION):
+            try:
+                data = compress.decompress_stream(data)
+            except ValueError:
+                raise s3err.ERR_INTERNAL_ERROR
+        return data, info
+
+    def select_object_content(self, req: S3Request) -> S3Response:
+        """POST /bucket/key?select&select-type=2 (ref
+        SelectObjectContentHandler, cmd/object-handlers.go; routed
+        cmd/api-router.go:161)."""
+        from ..s3select.select import S3SelectError, parse_request, \
+            run_select
+        try:
+            sel = parse_request(req.body)
+        except S3SelectError as e:
+            raise s3err.APIError(e.code, e.description, 400)
+        version_id = self._version_param(req)
+        try:
+            data, info = self._read_object_plain(req, version_id)
+        except BucketNotFound:
+            raise s3err.ERR_NO_SUCH_BUCKET
+        except MethodNotAllowed:
+            raise s3err.ERR_METHOD_NOT_ALLOWED
+        except ObjectNotFound:
+            if version_id:
+                raise s3err.ERR_NO_SUCH_VERSION
+            raise s3err.ERR_NO_SUCH_KEY
+        from ..event import event as ev
+        self._notify(ev.OBJECT_ACCESSED_GET, req.bucket, req.key, info)
+        return S3Response(200, run_select(sel, data),
+                          {"Content-Type": "application/octet-stream"})
 
     def get_object(self, req: S3Request, head: bool = False) -> S3Response:
         version_id = self._version_param(req)
@@ -1222,6 +1266,10 @@ class S3Server:
             if m == "GET":
                 return "s3:ListMultipartUploadParts", resource
             return "s3:PutObject", resource
+        if m == "POST" and "select" in p:
+            # SELECT scans object content: same grant as GetObject
+            # (ref SelectObjectContentHandler auth).
+            return "s3:GetObject", resource
         if m in ("GET", "HEAD"):
             if "versionId" in p:
                 return "s3:GetObjectVersion", resource
@@ -1305,6 +1353,8 @@ class S3Server:
             raise s3err.ERR_METHOD_NOT_ALLOWED
         if "tagging" in p:
             return h.object_tagging(req)
+        if m == "POST" and "select" in p:
+            return h.select_object_content(req)
         if m == "POST" and "uploads" in p:
             return h.initiate_multipart(req)
         if m == "POST" and "uploadId" in p:

@@ -1,0 +1,145 @@
+"""Input readers for S3 Select: CSV and JSON (DOCUMENT/LINES), with
+NONE/GZIP/BZIP2 source compression (ref pkg/s3select/csv, pkg/s3select/json;
+the reference's simdjson fast path is a SIMD host concern — here the
+readers are plain streaming parsers).
+"""
+
+from __future__ import annotations
+
+import bz2
+import csv as _csv
+import gzip
+import io
+import json
+
+from .sql import SQLError
+
+
+def decompress(data: bytes, compression: str) -> bytes:
+    c = (compression or "NONE").upper()
+    if c in ("NONE", ""):
+        return data
+    try:
+        if c == "GZIP":
+            return gzip.decompress(data)
+        if c == "BZIP2":
+            return bz2.decompress(data)
+    except OSError as e:
+        raise SQLError(f"bad compressed input: {e}")
+    raise SQLError(f"unsupported CompressionType {compression}")
+
+
+def csv_records(data: bytes, *, file_header_info: str = "NONE",
+                field_delimiter: str = ",", record_delimiter: str = "\n",
+                quote_character: str = '"',
+                quote_escape_character: str = '"',
+                comments: str = ""):
+    """Yield dict records from CSV bytes.
+
+    FileHeaderInfo (ref csv/args.go):
+      NONE   -> columns _1.._N
+      IGNORE -> first row skipped, columns _1.._N
+      USE    -> first row names the columns
+    """
+    text = data.decode("utf-8", errors="replace")
+    if record_delimiter and record_delimiter != "\n":
+        text = text.replace(record_delimiter, "\n")
+    src = io.StringIO(text)
+    reader = _csv.reader(
+        src, delimiter=field_delimiter or ",",
+        quotechar=quote_character or '"',
+        doublequote=(quote_escape_character == quote_character),
+        escapechar=(None if quote_escape_character == quote_character
+                    else quote_escape_character))
+    header: list[str] | None = None
+    mode = (file_header_info or "NONE").upper()
+    first = True
+    for row in reader:
+        if not row:
+            continue
+        if comments and row[0].startswith(comments):
+            continue
+        if first:
+            first = False
+            if mode == "USE":
+                header = [h.strip() for h in row]
+                continue
+            if mode == "IGNORE":
+                continue
+        if header is not None:
+            rec = {header[i] if i < len(header) else f"_{i + 1}": v
+                   for i, v in enumerate(row)}
+        else:
+            rec = {f"_{i + 1}": v for i, v in enumerate(row)}
+        yield rec
+
+
+def json_records(data: bytes, *, json_type: str = "LINES"):
+    """Yield dict records from JSON bytes.
+
+    LINES: one JSON value per line (blank lines skipped); DOCUMENT: one
+    value, or a top-level array = one record per element (ref
+    pkg/s3select/json/reader.go).
+    """
+    t = (json_type or "LINES").upper()
+    if t == "DOCUMENT":
+        try:
+            doc = json.loads(data)
+        except ValueError as e:
+            raise SQLError(f"invalid JSON document: {e}")
+        if isinstance(doc, list):
+            for el in doc:
+                yield el if isinstance(el, dict) else {"_1": el}
+        else:
+            yield doc if isinstance(doc, dict) else {"_1": doc}
+        return
+    if t != "LINES":
+        raise SQLError(f"unsupported JSON Type {json_type}")
+    dec = json.JSONDecoder()
+    text = data.decode("utf-8", errors="replace")
+    pos, n = 0, len(text)
+    while pos < n:
+        while pos < n and text[pos] in " \t\r\n":
+            pos += 1
+        if pos >= n:
+            break
+        try:
+            obj, end = dec.raw_decode(text, pos)
+        except ValueError as e:
+            raise SQLError(f"invalid JSON record at {pos}: {e}")
+        pos = end
+        yield obj if isinstance(obj, dict) else {"_1": obj}
+
+
+def format_csv(rows: list[dict], *, field_delimiter: str = ",",
+               record_delimiter: str = "\n",
+               quote_character: str = '"') -> bytes:
+    buf = io.StringIO()
+    w = _csv.writer(buf, delimiter=field_delimiter or ",",
+                    quotechar=quote_character or '"',
+                    lineterminator=record_delimiter or "\n")
+    for row in rows:
+        w.writerow([_csv_value(v) for v in row.values()])
+    return buf.getvalue().encode()
+
+
+def _csv_value(v) -> str:
+    if v is None:
+        return ""
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    if isinstance(v, (dict, list)):
+        return json.dumps(v, separators=(",", ":"))
+    return str(v)
+
+
+def format_json(rows: list[dict], *,
+                record_delimiter: str = "\n") -> bytes:
+    out = []
+    for row in rows:
+        out.append(json.dumps(row, separators=(",", ":"),
+                              default=str))
+    rd = record_delimiter or "\n"
+    return (rd.join(out) + rd).encode() if out else b""

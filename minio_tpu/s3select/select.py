@@ -1,0 +1,144 @@
+"""SelectObjectContent orchestrator: request XML -> readers -> SQL ->
+event-stream response (ref S3Select, pkg/s3select/select.go:208,
+Evaluate:398, NewS3Select:541)."""
+
+from __future__ import annotations
+
+import xml.etree.ElementTree as ET
+
+from . import message, readers, sql
+
+
+class S3SelectError(Exception):
+    def __init__(self, code: str, desc: str):
+        super().__init__(desc)
+        self.code = code
+        self.description = desc
+
+
+def _strip_ns(root: ET.Element) -> ET.Element:
+    for el in root.iter():
+        if "}" in el.tag:
+            el.tag = el.tag.split("}", 1)[1]
+    return root
+
+
+def parse_request(body: bytes) -> dict:
+    """Parse SelectObjectContentRequest XML into a plain dict
+    (ref ParseSelectParameters)."""
+    try:
+        root = _strip_ns(ET.fromstring(body))
+    except ET.ParseError as e:
+        raise S3SelectError("MalformedXML", f"invalid request XML: {e}")
+    if root.tag != "SelectObjectContentRequest":
+        raise S3SelectError("MalformedXML",
+                            f"unexpected root {root.tag}")
+    expr = root.findtext("Expression") or ""
+    etype = (root.findtext("ExpressionType") or "SQL").upper()
+    if etype != "SQL":
+        raise S3SelectError("InvalidExpressionType",
+                            f"unsupported ExpressionType {etype}")
+    req = {"expression": expr, "input": {}, "output": {},
+           "progress": False}
+    ins = root.find("InputSerialization")
+    if ins is None:
+        raise S3SelectError("MalformedXML", "missing InputSerialization")
+    req["input"]["compression"] = ins.findtext("CompressionType") or "NONE"
+    csv_el = ins.find("CSV")
+    json_el = ins.find("JSON")
+    parquet_el = ins.find("Parquet")
+    if csv_el is not None:
+        req["input"]["format"] = "CSV"
+        req["input"]["csv"] = {
+            "FileHeaderInfo": csv_el.findtext("FileHeaderInfo") or "NONE",
+            "RecordDelimiter": csv_el.findtext("RecordDelimiter") or "\n",
+            "FieldDelimiter": csv_el.findtext("FieldDelimiter") or ",",
+            "QuoteCharacter": csv_el.findtext("QuoteCharacter") or '"',
+            "QuoteEscapeCharacter":
+                csv_el.findtext("QuoteEscapeCharacter") or '"',
+            "Comments": csv_el.findtext("Comments") or "",
+        }
+    elif json_el is not None:
+        req["input"]["format"] = "JSON"
+        req["input"]["json"] = {
+            "Type": json_el.findtext("Type") or "LINES"}
+    elif parquet_el is not None:
+        raise S3SelectError(
+            "UnsupportedFormat",
+            "Parquet input is not supported by this build")
+    else:
+        raise S3SelectError("MalformedXML",
+                            "InputSerialization needs CSV or JSON")
+    outs = root.find("OutputSerialization")
+    if outs is None:
+        raise S3SelectError("MalformedXML",
+                            "missing OutputSerialization")
+    ocsv = outs.find("CSV")
+    ojson = outs.find("JSON")
+    if ocsv is not None:
+        req["output"]["format"] = "CSV"
+        req["output"]["csv"] = {
+            "RecordDelimiter": ocsv.findtext("RecordDelimiter") or "\n",
+            "FieldDelimiter": ocsv.findtext("FieldDelimiter") or ",",
+            "QuoteCharacter": ocsv.findtext("QuoteCharacter") or '"',
+        }
+    elif ojson is not None:
+        req["output"]["format"] = "JSON"
+        req["output"]["json"] = {
+            "RecordDelimiter": ojson.findtext("RecordDelimiter") or "\n"}
+    else:
+        raise S3SelectError("MalformedXML",
+                            "OutputSerialization needs CSV or JSON")
+    prog = root.find("RequestProgress")
+    if prog is not None and (prog.findtext("Enabled") or ""
+                             ).lower() == "true":
+        req["progress"] = True
+    return req
+
+
+def run_select(req: dict, data: bytes) -> bytes:
+    """Execute a parsed select request over object bytes; returns the
+    full event-stream response body."""
+    raw_len = len(data)
+    try:
+        data = readers.decompress(data, req["input"].get("compression"))
+        if req["input"]["format"] == "CSV":
+            c = req["input"]["csv"]
+            records = readers.csv_records(
+                data,
+                file_header_info=c["FileHeaderInfo"],
+                field_delimiter=c["FieldDelimiter"],
+                record_delimiter=c["RecordDelimiter"],
+                quote_character=c["QuoteCharacter"],
+                quote_escape_character=c["QuoteEscapeCharacter"],
+                comments=c["Comments"])
+        else:
+            records = readers.json_records(
+                data, json_type=req["input"]["json"]["Type"])
+        query = sql.parse(req["expression"])
+        rows = sql.execute(query, records)
+        if req["output"]["format"] == "CSV":
+            o = req["output"]["csv"]
+            payload = readers.format_csv(
+                rows, field_delimiter=o["FieldDelimiter"],
+                record_delimiter=o["RecordDelimiter"],
+                quote_character=o["QuoteCharacter"])
+        else:
+            payload = readers.format_json(
+                rows,
+                record_delimiter=req["output"]["json"]["RecordDelimiter"])
+    except sql.SQLError as e:
+        return message.error_message("InvalidQuery", str(e))
+    except S3SelectError as e:
+        return message.error_message(e.code, e.description)
+
+    frames = []
+    if req.get("progress"):
+        frames.append(message.progress_message(raw_len, len(data),
+                                               len(payload)))
+    for i in range(0, len(payload), 1 << 20):
+        frames.append(message.records_message(payload[i:i + (1 << 20)]))
+    frames.append(message.stats_message(raw_len, len(data),
+                                        len(payload)))
+    frames.append(message.end_message())
+    return b"".join(frames)
