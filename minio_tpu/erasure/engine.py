@@ -601,8 +601,18 @@ class ErasureObjects:
                         version_id: str = "") -> None:
         """Replace the object's tag set in-place in xl.meta (ref
         PutObjectTags, cmd/erasure-object.go — a metadata-only update;
-        "" clears). Each disk rewrites ITS OWN FileInfo so per-disk
-        erasure indices stay intact."""
+        "" clears)."""
+        self.update_object_metadata(bucket, object_name,
+                                    {"x-amz-tagging": tags or None},
+                                    version_id)
+
+    def update_object_metadata(self, bucket: str, object_name: str,
+                               updates: dict, version_id: str = "") -> None:
+        """Metadata-only in-place xl.meta update under write quorum (a
+        None value deletes the key). Each disk rewrites ITS OWN FileInfo
+        so per-disk erasure indices stay intact (ref the updateObjectMeta
+        pattern shared by PutObjectTags and replication-status writes,
+        cmd/erasure-object.go)."""
         self._check_bucket(bucket)
         with self.ns_lock.write_locked(bucket, object_name):
             fi, agreed = self._quorum_file_info(bucket, object_name,
@@ -616,17 +626,18 @@ class ErasureObjects:
                 own = agreed[i]
                 if own is None:
                     return  # out-of-quorum disk; healing repairs it
-                if tags:
-                    own.metadata["x-amz-tagging"] = tags
-                else:
-                    own.metadata.pop("x-amz-tagging", None)
+                for k, v in updates.items():
+                    if v is None:
+                        own.metadata.pop(k, None)
+                    else:
+                        own.metadata[k] = v
                 self.disks[i].write_metadata(bucket, object_name, own)
 
             _, errs = parallel_map(
                 [lambda i=i: update_one(i)
                  for i in range(len(self.disks))])
             reduce_quorum_errs(errs, write_quorum(self.k, self.m),
-                               "put_object_tags")
+                               "update_object_metadata")
         self._mark_update(bucket, object_name)
 
     def walk_object_names(self, bucket: str) -> list[str]:

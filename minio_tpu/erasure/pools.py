@@ -134,6 +134,12 @@ class ErasureServerPools:
                            lambda p: p.put_object_tags(
                                bucket, object_name, tags, version_id))
 
+    def update_object_metadata(self, bucket: str, object_name: str,
+                               updates: dict, version_id: str = "") -> None:
+        return self._probe(bucket, object_name,
+                           lambda p: p.update_object_metadata(
+                               bucket, object_name, updates, version_id))
+
     def list_object_versions(self, bucket: str, prefix: str = "",
                              max_keys: int = 1000,
                              marker: str = "") -> list[ObjectInfo]:

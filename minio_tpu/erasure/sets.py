@@ -110,6 +110,11 @@ class ErasureSets:
         return self.set_for(object_name).put_object_tags(
             bucket, object_name, tags, version_id)
 
+    def update_object_metadata(self, bucket: str, object_name: str,
+                               updates: dict, version_id: str = "") -> None:
+        return self.set_for(object_name).update_object_metadata(
+            bucket, object_name, updates, version_id)
+
     def list_object_versions(self, bucket: str, prefix: str = "",
                              max_keys: int = 1000,
                              marker: str = "") -> list[ObjectInfo]:

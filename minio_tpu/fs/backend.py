@@ -259,13 +259,21 @@ class FSObjects:
 
     def put_object_tags(self, bucket: str, object_name: str, tags: str,
                         version_id: str = "") -> None:
+        self.update_object_metadata(bucket, object_name,
+                                    {"x-amz-tagging": tags or None},
+                                    version_id)
+
+    def update_object_metadata(self, bucket: str, object_name: str,
+                               updates: dict, version_id: str = "") -> None:
+        """Metadata-only fs.json update; None value deletes the key."""
         info = self.get_object_info(bucket, object_name,
                                     version_id=version_id)
         meta = dict(info.metadata)
-        if tags:
-            meta["x-amz-tagging"] = tags
-        else:
-            meta.pop("x-amz-tagging", None)
+        for k, v in updates.items():
+            if v is None:
+                meta.pop(k, None)
+            else:
+                meta[k] = v
         doc = self._read_fs_json(bucket, object_name)
         self._write_fs_json(bucket, object_name, meta, size=info.size,
                             parts=doc.get("parts"))

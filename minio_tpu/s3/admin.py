@@ -241,6 +241,33 @@ class AdminHandlers:
                     "dangling": r.dangling})
         return {"items": results}
 
+    # -- replication remote targets (ref SetRemoteTargetHandler etc.,
+    # cmd/admin-bucket-handlers.go) ------------------------------------
+
+    def _replication(self):
+        return self.server.handlers.replication
+
+    def h_set_remote_target(self, p, body):
+        doc = json.loads(body)
+        arn = self._replication().targets.set_target(
+            p["bucket"], doc["endpoint"], doc["target_bucket"],
+            doc["access_key"], doc["secret_key"])
+        return {"arn": arn}
+
+    def h_list_remote_targets(self, p, body):
+        targets = self._replication().targets.list_targets(p["bucket"])
+        # Never return secrets over the wire (parity with madmin's
+        # redacted listing).
+        return {"targets": [{k: v for k, v in t.items()
+                             if k != "secret_key"} for t in targets]}
+
+    def h_remove_remote_target(self, p, body):
+        self._replication().targets.remove_target(p["bucket"], p["arn"])
+        return {"ok": True}
+
+    def h_replication_stats(self, p, body):
+        return dict(self._replication().stats)
+
     # -- locks ----------------------------------------------------------
 
     def h_top_locks(self, p, body):

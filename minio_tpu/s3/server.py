@@ -115,6 +115,53 @@ class S3ApiHandlers:
         self.notifier = notifier
         from ..crypto.sse import LocalKMS
         self.kms = LocalKMS.from_env()
+        from ..bucket.replication import ReplicationPool
+        self.replication = ReplicationPool(
+            self.bucket_meta, self.read_for_replication, layer)
+
+    # ---------------- replication plumbing ----------------
+
+    def read_for_replication(self, bucket: str, key: str,
+                             version_id: str = ""):
+        """Logical object bytes + info for the replication worker —
+        SSE-S3 decrypts under the local KMS, SSE-C is unreadable
+        server-side (the reference likewise skips SSE-C sources)."""
+        from ..crypto import sse
+        from ..utils import compress
+        info = self.layer.get_object_info(bucket, key, version_id)
+        mode = sse.is_encrypted(info.metadata)
+        if mode == sse.SSE_C:
+            raise ValueError("SSE-C objects cannot be replicated")
+        if mode:
+            okey = sse.unseal_key(self.kms.master,
+                                  info.metadata[sse.META_SEALED_KEY],
+                                  mode, bucket, key)
+            data = self._sse_decrypt_read(version_id, info, okey, 0,
+                                          info.size)
+        else:
+            data, info = self.layer.get_object(bucket, key,
+                                               version_id=version_id)
+        if info.metadata.get(compress.META_COMPRESSION):
+            data = compress.decompress_stream(data)
+        return data, info
+
+    def _replication_decision(self, req: S3Request, meta: dict) -> None:
+        """Stamp the new object's replication status before the write:
+        REPLICA for incoming replica traffic, PENDING when a rule
+        matches (ref mustReplicate, cmd/bucket-replication.go:100)."""
+        from ..bucket.replication import (META_REPLICATION_STATUS,
+                                          PENDING, REPLICA)
+        if req.headers.get(META_REPLICATION_STATUS) == REPLICA:
+            meta[META_REPLICATION_STATUS] = REPLICA
+        elif self.replication.must_replicate(req.bucket, req.key):
+            meta[META_REPLICATION_STATUS] = PENDING
+
+    def _queue_replication(self, req: S3Request, info: ObjectInfo,
+                           meta: dict) -> None:
+        from ..bucket.replication import META_REPLICATION_STATUS, PENDING
+        if meta.get(META_REPLICATION_STATUS) == PENDING:
+            self.replication.queue_task(req.bucket, req.key,
+                                        info.version_id, "put")
 
     def _notify(self, event_name: str, bucket: str, key: str,
                 info: ObjectInfo | None = None,
@@ -318,6 +365,9 @@ class S3ApiHandlers:
         }
         if info.version_id:
             h["x-amz-version-id"] = info.version_id
+        if "x-amz-replication-status" in info.metadata:
+            h["x-amz-replication-status"] = \
+                info.metadata["x-amz-replication-status"]
         for k, v in info.metadata.items():
             if k.startswith("x-amz-meta-"):
                 h[k] = v
@@ -447,7 +497,7 @@ class S3ApiHandlers:
         raw = info.metadata.get(sse.META_ACTUAL_SIZE)
         return int(raw) if raw is not None else info.size
 
-    def _sse_decrypt_read(self, req: S3Request, info: ObjectInfo,
+    def _sse_decrypt_read(self, version_id: str, info: ObjectInfo,
                           okey: bytes, offset: int,
                           length: int) -> bytes:
         """Read [offset, offset+length) of the PLAINTEXT, touching only
@@ -456,7 +506,6 @@ class S3ApiHandlers:
         part sizes (ref DecryptBlocksRequestR part-boundary walk,
         cmd/encryption-v1.go:356)."""
         from ..crypto import sse
-        version_id = self._version_param(req)
         multipart = info.metadata.get(sse.META_SSE_MULTIPART) == "1"
 
         def ranged_read(base_off, size_limit):
@@ -516,6 +565,7 @@ class S3ApiHandlers:
             meta["x-amz-tagging"] = req.headers["x-amz-tagging"]
         body = self._maybe_compress(req.key, req.body, meta)
         body = self._sse_encrypt_body(req, body, meta)
+        self._replication_decision(req, meta)
         try:
             info = self.layer.put_object(
                 req.bucket, req.key, body, metadata=meta,
@@ -532,6 +582,7 @@ class S3ApiHandlers:
             h["x-amz-version-id"] = info.version_id
         from ..event import event as ev
         self._notify(ev.OBJECT_CREATED_PUT, req.bucket, req.key, info)
+        self._queue_replication(req, info, meta)
         return S3Response(200, headers=h)
 
     def copy_object(self, req: S3Request) -> S3Response:
@@ -556,16 +607,19 @@ class S3ApiHandlers:
                     meta[k] = v
         # The copy re-evaluates encryption/compression for the
         # destination; the source's envelope must never leak across.
+        from ..bucket.replication import META_REPLICATION_STATUS
         for k in (sse.META_ALGORITHM, sse.META_SEALED_KEY,
                   sse.META_KEY_MD5, sse.META_KMS_KEY_ID,
                   sse.META_ACTUAL_SIZE, compress.META_COMPRESSION,
-                  "etag"):
+                  META_REPLICATION_STATUS, "etag"):
             meta.pop(k, None)
         data = self._maybe_compress(req.key, data, meta)
         data = self._sse_encrypt_body(req, data, meta)
+        self._replication_decision(req, meta)
         info = self.layer.put_object(req.bucket, req.key, data,
                                      metadata=meta,
                                      versioned=self._versioned(req.bucket))
+        self._queue_replication(req, info, meta)
         root = Element("CopyObjectResult", S3_XMLNS)
         root.child("ETag", f'"{info.etag}"')
         root.child("LastModified", _iso8601(info.mod_time))
@@ -589,7 +643,8 @@ class S3ApiHandlers:
         okey = self._sse_unseal_for_read(req, info,
                                          copy_source=copy_source)
         if okey is not None:
-            data = self._sse_decrypt_read(req, info, okey, 0, info.size)
+            data = self._sse_decrypt_read(version_id, info, okey, 0,
+                                          info.size)
         else:
             data, info = self.layer.get_object(bucket, key,
                                                version_id=version_id)
@@ -644,8 +699,8 @@ class S3ApiHandlers:
                     # SSE's inner plaintext IS the compressed stream;
                     # its length <= stored size, so that bound reads all.
                     if okey is not None:
-                        blob = self._sse_decrypt_read(req, info, okey,
-                                                      0, info.size)
+                        blob = self._sse_decrypt_read(version_id, info,
+                                                      okey, 0, info.size)
                     else:
                         blob, _ = self.layer.get_object(
                             req.bucket, req.key, version_id=version_id)
@@ -659,7 +714,7 @@ class S3ApiHandlers:
                         raise s3err.ERR_INTERNAL_ERROR
                 elif okey is not None:
                     off, ln = rng if rng is not None else (0, size)
-                    data = self._sse_decrypt_read(req, info, okey,
+                    data = self._sse_decrypt_read(version_id, info, okey,
                                                   off, ln)
                 elif rng is None:
                     data, info = self.layer.get_object(
@@ -804,6 +859,20 @@ class S3ApiHandlers:
         from ..event import event as ev
         self._notify(ev.OBJECT_CREATED_COMPLETE_MULTIPART,
                      req.bucket, req.key, info)
+        # Multipart metadata was fixed at initiate time; stamp + queue
+        # the replication AFTER the stitch (ref CompleteMultipartUpload
+        # replication hook, cmd/object-handlers.go).
+        if self.replication.must_replicate(req.bucket, req.key):
+            from ..bucket.replication import (META_REPLICATION_STATUS,
+                                              PENDING)
+            try:
+                self.layer.update_object_metadata(
+                    req.bucket, req.key,
+                    {META_REPLICATION_STATUS: PENDING}, info.version_id)
+            except Exception:
+                pass
+            self.replication.queue_task(req.bucket, req.key,
+                                        info.version_id, "put")
         return S3Response(200, root.tobytes(),
                           {"Content-Type": "application/xml"})
 
@@ -1133,6 +1202,13 @@ class S3ApiHandlers:
                 ev.OBJECT_REMOVED_DELETE_MARKER if deleted.delete_marker
                 else ev.OBJECT_REMOVED_DELETE,
                 req.bucket, req.key, deleted)
+            # Only a NEW marker replicates; purging a marker version
+            # ("undelete") must not delete the replica.
+            if deleted.delete_marker and not version_id and \
+                    self.replication.replicates_deletes(req.bucket,
+                                                        req.key):
+                self.replication.queue_task(req.bucket, req.key, "",
+                                            "delete")
         except (ObjectNotFound, BucketNotFound):
             if version_id:  # S3 DELETE is idempotent-success on missing keys
                 h["x-amz-version-id"] = version_id
@@ -1560,3 +1636,5 @@ class S3Server:
             self._httpd.server_close()
         if self.notifier is not None:
             self.notifier.close()
+        if self.handlers is not None:
+            self.handlers.replication.close()

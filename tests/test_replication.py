@@ -1,0 +1,189 @@
+"""Bucket replication: two live servers, remote target registry, async
+CRR with status protocol (ref cmd/bucket-replication.go,
+cmd/bucket-targets.go; test pattern: the reference exercises replication
+decisions in cmd/bucket-replication_test.go and relies on live setups
+for end-to-end)."""
+
+import json
+import time
+
+import pytest
+
+from minio_tpu.bucket.replication import (COMPLETED, PENDING, REPLICA,
+                                          ReplicationConfig)
+from minio_tpu.erasure.engine import ErasureObjects
+from minio_tpu.s3.client import S3Client
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.xl import XLStorage
+
+ACCESS, SECRET = "repladmin", "repladmin-secret"
+
+REPL_XML = """<ReplicationConfiguration>
+  <Role>arn:minio:replication</Role>
+  <Rule>
+    <ID>rule1</ID>
+    <Status>Enabled</Status>
+    <Priority>1</Priority>
+    <DeleteMarkerReplication><Status>Enabled</Status></DeleteMarkerReplication>
+    <Destination><Bucket>{arn}</Bucket></Destination>
+  </Rule>
+</ReplicationConfiguration>"""
+
+
+def _mk_server(tmp_path, name):
+    disks = [XLStorage(str(tmp_path / name / f"d{i}")) for i in range(4)]
+    layer = ErasureObjects(disks, block_size=64 * 1024)
+    srv = S3Server(layer, ACCESS, SECRET)
+    port = srv.start()
+    return srv, port
+
+
+@pytest.fixture
+def pair(tmp_path):
+    src_srv, src_port = _mk_server(tmp_path, "src")
+    dst_srv, dst_port = _mk_server(tmp_path, "dst")
+    src = S3Client("127.0.0.1", src_port, ACCESS, SECRET)
+    dst = S3Client("127.0.0.1", dst_port, ACCESS, SECRET)
+    assert src.make_bucket("srcb").status == 200
+    assert dst.make_bucket("dstb").status == 200
+    yield src_srv, src, dst_srv, dst, dst_port
+    src_srv.stop()
+    dst_srv.stop()
+
+
+def _setup_replication(src_srv, src, dst_port):
+    """Register the remote target via the admin API and install the
+    replication config; returns the ARN."""
+    r = src.request(
+        "POST", "/minio-tpu/admin/v1/set-remote-target",
+        query="bucket=srcb",
+        body=json.dumps({
+            "endpoint": f"127.0.0.1:{dst_port}",
+            "target_bucket": "dstb",
+            "access_key": ACCESS, "secret_key": SECRET,
+        }).encode())
+    assert r.status == 200, r.body
+    arn = json.loads(r.body)["arn"]
+    xml = REPL_XML.format(arn=arn).encode()
+    assert src.request("PUT", "/srcb", query="replication",
+                       body=xml).status == 200
+    return arn
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_replicate_put(pair):
+    src_srv, src, _dst_srv, dst, dst_port = pair
+    _setup_replication(src_srv, src, dst_port)
+    r = src.put_object("srcb", "docs/a.txt", b"replicate me",
+                       headers={"x-amz-meta-team": "storage",
+                                "content-type": "text/plain"})
+    assert r.status == 200
+
+    assert _wait(lambda: dst.get_object("dstb", "docs/a.txt").status == 200)
+    got = dst.get_object("dstb", "docs/a.txt")
+    assert got.body == b"replicate me"
+    assert got.headers.get("x-amz-replication-status") == REPLICA
+    assert got.headers.get("x-amz-meta-team") == "storage"
+    assert got.headers.get("content-type") == "text/plain"
+
+    # Source flips PENDING -> COMPLETED once the worker lands it.
+    assert _wait(lambda: src.head_object("srcb", "docs/a.txt").headers.get(
+        "x-amz-replication-status") == COMPLETED)
+
+
+def test_replicate_delete_marker(pair):
+    src_srv, src, _dst_srv, dst, dst_port = pair
+    _setup_replication(src_srv, src, dst_port)
+    # Versioned source so the delete writes a marker.
+    assert src.request(
+        "PUT", "/srcb", query="versioning",
+        body=b"<VersioningConfiguration><Status>Enabled</Status>"
+             b"</VersioningConfiguration>").status == 200
+    src.put_object("srcb", "gone.txt", b"x")
+    assert _wait(lambda: dst.get_object("dstb", "gone.txt").status == 200)
+    assert src.delete_object("srcb", "gone.txt").status == 204
+    assert _wait(lambda: dst.get_object("dstb", "gone.txt").status == 404)
+
+
+def test_target_down_marks_failed(pair):
+    src_srv, src, dst_srv, _dst, dst_port = pair
+    _setup_replication(src_srv, src, dst_port)
+    dst_srv.stop()
+    src.put_object("srcb", "orphan.txt", b"nowhere to go")
+    assert _wait(lambda: src.head_object("srcb", "orphan.txt").headers.get(
+        "x-amz-replication-status") == "FAILED", timeout=10)
+    stats = src_srv.handlers.replication.stats
+    assert stats["failed_count"] >= 1
+
+
+def test_remote_target_admin_roundtrip(pair):
+    src_srv, src, _dst_srv, _dst, dst_port = pair
+    arn = _setup_replication(src_srv, src, dst_port)
+    r = src.request("GET", "/minio-tpu/admin/v1/list-remote-targets",
+                    query="bucket=srcb")
+    targets = json.loads(r.body)["targets"]
+    assert [t["arn"] for t in targets] == [arn]
+    assert all("secret_key" not in t for t in targets)
+    r = src.request("POST", "/minio-tpu/admin/v1/remove-remote-target",
+                    query=f"bucket=srcb&arn={arn}")
+    assert r.status == 200
+    r = src.request("GET", "/minio-tpu/admin/v1/list-remote-targets",
+                    query="bucket=srcb")
+    assert json.loads(r.body)["targets"] == []
+
+
+def test_no_replication_without_config(pair):
+    src_srv, src, _dst_srv, dst, _dst_port = pair
+    src.put_object("srcb", "plain.txt", b"stay home")
+    time.sleep(0.2)
+    assert dst.get_object("dstb", "plain.txt").status == 404
+    h = src.head_object("srcb", "plain.txt")
+    assert "x-amz-replication-status" not in h.headers
+
+
+# ---------------------------------------------------------------------------
+# Unit: config parsing + decision (ref mustReplicate table tests)
+# ---------------------------------------------------------------------------
+
+
+def test_config_parse_and_match():
+    cfg = ReplicationConfig.from_xml("""
+      <ReplicationConfiguration>
+        <Rule><ID>hi</ID><Status>Enabled</Status><Priority>2</Priority>
+          <Filter><Prefix>logs/</Prefix></Filter>
+          <Destination><Bucket>arn:aws:s3:::t1</Bucket></Destination>
+        </Rule>
+        <Rule><ID>lo</ID><Status>Enabled</Status><Priority>1</Priority>
+          <Destination><Bucket>arn:aws:s3:::t2</Bucket></Destination>
+        </Rule>
+        <Rule><ID>off</ID><Status>Disabled</Status><Priority>9</Priority>
+          <Destination><Bucket>arn:aws:s3:::t3</Bucket></Destination>
+        </Rule>
+      </ReplicationConfiguration>""")
+    # Disabled rule never matches, even at top priority.
+    assert cfg.rule_for("logs/a").rule_id == "hi"
+    assert cfg.rule_for("other").rule_id == "lo"
+    assert cfg.rules[0].rule_id == "off"  # sorted by priority only
+
+
+def test_pending_status_stamped_synchronously(pair):
+    """The PENDING stamp must be on the stored object BEFORE the worker
+    runs (crash safety: a lost worker leaves a resumable PENDING, not a
+    silently-unreplicated object)."""
+    src_srv, src, _dst_srv, _dst, dst_port = pair
+    _setup_replication(src_srv, src, dst_port)
+    # Pause workers by swapping the queue processor: just inspect
+    # metadata straight after PUT; worker may or may not have run, so
+    # accept either PENDING or COMPLETED — never absent.
+    src.put_object("srcb", "stamp.txt", b"s")
+    st = src.head_object("srcb", "stamp.txt").headers.get(
+        "x-amz-replication-status")
+    assert st in (PENDING, COMPLETED)
