@@ -140,3 +140,18 @@ ERR_SSE_KEY_MISMATCH = _e(
 ERR_INVALID_SSE_PARAMS = _e(
     "InvalidArgument",
     "Invalid server side encryption parameters", 400)
+ERR_INVALID_BUCKET_STATE = _e(
+    "InvalidBucketState",
+    "Object Lock configuration cannot be enabled on existing buckets", 409)
+ERR_OBJECT_LOCKED = _e(
+    "AccessDenied",
+    "Object is WORM protected and cannot be overwritten or deleted", 403)
+ERR_PAST_OBJECT_LOCK_RETAIN_DATE = _e(
+    "InvalidRequest",
+    "the retain until date must be in the future", 400)
+ERR_INVALID_RETENTION_MODE = _e(
+    "InvalidRequest",
+    "invalid retention mode, expected GOVERNANCE or COMPLIANCE", 400)
+ERR_NO_SUCH_RETENTION = _e(
+    "NoSuchObjectLockConfiguration",
+    "The specified object does not have a ObjectLock configuration", 404)

@@ -212,6 +212,15 @@ class S3ApiHandlers:
             self.layer.make_bucket(req.bucket)
         except BucketExists:
             raise s3err.ERR_BUCKET_ALREADY_EXISTS
+        if req.headers.get(
+                "x-amz-bucket-object-lock-enabled", "").lower() == "true":
+            # Lock can only be enabled at creation; it force-enables
+            # versioning (ref MakeBucketWithObjectLock,
+            # cmd/bucket-handlers.go).
+            from ..bucket import objectlock as ol
+            self.bucket_meta.update(req.bucket,
+                                    object_lock_xml=ol.ENABLED_XML,
+                                    versioning="Enabled")
         return S3Response(200, headers={"Location": f"/{req.bucket}"})
 
     def head_bucket(self, req: S3Request) -> S3Response:
@@ -324,6 +333,9 @@ class S3ApiHandlers:
             if vid == "null":
                 vid = ""
             try:
+                self._check_version_delete_allowed(
+                    req.bucket, key, vid,
+                    self._can_bypass_governance(req))
                 deleted = self.layer.delete_object(req.bucket, key, vid,
                                                    versioned=versioned)
                 from ..event import event as ev
@@ -345,6 +357,10 @@ class S3ApiHandlers:
                 if not quiet:  # S3 treats missing keys as deleted
                     d = root.child("Deleted")
                     d.child("Key", key)
+            except APIError as e2:
+                e = root.child("Error")
+                e.child("Key", key)
+                e.child("Code", e2.code)
             except Exception:
                 e = root.child("Error")
                 e.child("Key", key)
@@ -563,6 +579,7 @@ class S3ApiHandlers:
                 meta[k] = v
         if "x-amz-tagging" in req.headers:
             meta["x-amz-tagging"] = req.headers["x-amz-tagging"]
+        self._apply_lock_headers(req, meta)
         body = self._maybe_compress(req.key, req.body, meta)
         body = self._sse_encrypt_body(req, body, meta)
         self._replication_decision(req, meta)
@@ -607,12 +624,15 @@ class S3ApiHandlers:
                     meta[k] = v
         # The copy re-evaluates encryption/compression for the
         # destination; the source's envelope must never leak across.
+        from ..bucket import objectlock as ol
         from ..bucket.replication import META_REPLICATION_STATUS
         for k in (sse.META_ALGORITHM, sse.META_SEALED_KEY,
                   sse.META_KEY_MD5, sse.META_KMS_KEY_ID,
                   sse.META_ACTUAL_SIZE, compress.META_COMPRESSION,
-                  META_REPLICATION_STATUS, "etag"):
+                  META_REPLICATION_STATUS, ol.META_MODE,
+                  ol.META_RETAIN_UNTIL, ol.META_LEGAL_HOLD, "etag"):
             meta.pop(k, None)
+        self._apply_lock_headers(req, meta)
         data = self._maybe_compress(req.key, data, meta)
         data = self._sse_encrypt_body(req, data, meta)
         self._replication_decision(req, meta)
@@ -786,6 +806,7 @@ class S3ApiHandlers:
         for k, v in req.headers.items():
             if k.startswith("x-amz-meta-"):
                 meta[k] = v
+        self._apply_lock_headers(req, meta)
         self._sse_init_multipart(req, meta)
         try:
             upload_id = self.layer.multipart.new_multipart_upload(
@@ -947,6 +968,10 @@ class S3ApiHandlers:
         if not getattr(self.layer, "supports_versioning", True):
             # ref FS backend: versioning APIs -> NotImplemented
             raise s3err.ERR_NOT_IMPLEMENTED
+        if status == "Suspended" and self._lock_config(req.bucket).enabled:
+            # Suspension would turn plain deletes into data-destroying
+            # deletes, voiding WORM (AWS: InvalidBucketState).
+            raise s3err.ERR_INVALID_BUCKET_STATE
         self.bucket_meta.update(req.bucket, versioning=status)
         return S3Response(200)
 
@@ -1126,9 +1151,31 @@ class S3ApiHandlers:
                                 s3err.ERR_NO_SUCH_TAG_SET)
 
     def bucket_object_lock(self, req: S3Request) -> S3Response:
-        return self._xml_config(req, "object_lock_xml",
-                                "ObjectLockConfiguration",
-                                s3err.ERR_NO_SUCH_OBJECT_LOCK_CONFIG)
+        """Lock config is append-only state: it can never be removed or
+        disabled once set, or WORM would be trivially escapable (ref
+        PutBucketObjectLockConfigHandler gating,
+        cmd/bucket-object-lock.go)."""
+        from ..bucket import objectlock as ol
+        self._check_bucket_exists(req)
+        if req.method == "GET":
+            raw = self.bucket_meta.get(req.bucket).object_lock_xml
+            if not raw:
+                raise s3err.ERR_NO_SUCH_OBJECT_LOCK_CONFIG
+            return S3Response(200, raw.encode(),
+                              {"Content-Type": "application/xml"})
+        if req.method == "DELETE":
+            raise s3err.ERR_METHOD_NOT_ALLOWED
+        if not self._lock_config(req.bucket).enabled:
+            raise s3err.ERR_INVALID_BUCKET_STATE
+        try:
+            cfg = ol.ObjectLockConfig.from_xml(req.body)
+        except Exception:
+            raise s3err.ERR_MALFORMED_XML
+        if not cfg.enabled:
+            raise s3err.ERR_MALFORMED_XML
+        self.bucket_meta.update(req.bucket,
+                                object_lock_xml=req.body.decode("utf-8"))
+        return S3Response(200)
 
     def bucket_replication(self, req: S3Request) -> S3Response:
         return self._xml_config(req, "replication_xml",
@@ -1186,8 +1233,145 @@ class S3ApiHandlers:
         except (ObjectNotFound, BucketNotFound):
             raise s3err.ERR_NO_SUCH_KEY
 
+    # ---------------- object lock ----------------
+
+    def _lock_config(self, bucket: str):
+        from ..bucket import objectlock as ol
+        try:
+            return ol.ObjectLockConfig.from_xml(
+                self.bucket_meta.get(bucket).object_lock_xml)
+        except ol.ObjectLockError:
+            return ol.ObjectLockConfig()
+
+    def _apply_lock_headers(self, req: S3Request, meta: dict) -> None:
+        """Stamp retention/legal-hold metadata on a new object/upload
+        from its headers or the bucket default."""
+        from ..bucket import objectlock as ol
+        cfg = self._lock_config(req.bucket)
+        has_hdrs = (ol.META_MODE in req.headers
+                    or ol.META_RETAIN_UNTIL in req.headers
+                    or ol.META_LEGAL_HOLD in req.headers)
+        if not cfg.enabled:
+            if has_hdrs:
+                raise s3err.ERR_INVALID_BUCKET_STATE
+            return
+        try:
+            ol.apply_put_headers(req.headers, cfg, meta)
+        except ol.PastRetainDate:
+            raise s3err.ERR_PAST_OBJECT_LOCK_RETAIN_DATE
+        except ol.BadLockDate:
+            raise s3err.ERR_INVALID_ARGUMENT
+        except ol.ObjectLockError:
+            raise s3err.ERR_INVALID_RETENTION_MODE
+
+    @staticmethod
+    def _can_bypass_governance(req: S3Request) -> bool:
+        """Header present; the s3:BypassGovernanceRetention grant is
+        enforced by S3Server.authorize before dispatch."""
+        from ..bucket import objectlock as ol
+        return req.headers.get(ol.H_BYPASS_GOVERNANCE,
+                               "").lower() == "true"
+
+    def _check_version_delete_allowed(self, bucket: str, key: str,
+                                      version_id: str,
+                                      bypass: bool) -> None:
+        """Versioned deletes destroy data: enforce WORM on the target
+        version (plain deletes only write markers and pass)."""
+        from ..bucket import objectlock as ol
+        if not version_id:
+            return
+        if not self._lock_config(bucket).enabled:
+            return
+        try:
+            info = self.layer.get_object_info(bucket, key, version_id)
+        except (ObjectNotFound, BucketNotFound, MethodNotAllowed):
+            return  # missing/marker version: nothing to protect
+        if info.delete_marker:
+            return
+        try:
+            ol.check_version_delete(info.metadata, bypass)
+        except ol.ObjectLockError:
+            raise s3err.ERR_OBJECT_LOCKED
+
+    def object_retention(self, req: S3Request) -> S3Response:
+        """GET/PUT /bucket/key?retention (ref
+        PutObjectRetentionHandler, cmd/object-handlers.go)."""
+        from ..bucket import objectlock as ol
+        version_id = self._version_param(req)
+        try:
+            info = self.layer.get_object_info(req.bucket, req.key,
+                                              version_id)
+        except (ObjectNotFound, BucketNotFound):
+            raise s3err.ERR_NO_SUCH_KEY
+        except MethodNotAllowed:
+            raise s3err.ERR_METHOD_NOT_ALLOWED
+        if req.method == "GET":
+            mode = info.metadata.get(ol.META_MODE, "")
+            until = info.metadata.get(ol.META_RETAIN_UNTIL, "")
+            if not mode:
+                raise s3err.ERR_NO_SUCH_RETENTION
+            root = Element("Retention", S3_XMLNS)
+            root.child("Mode", mode)
+            root.child("RetainUntilDate", until)
+            return S3Response(200, root.tobytes(),
+                              {"Content-Type": "application/xml"})
+        if not self._lock_config(req.bucket).enabled:
+            raise s3err.ERR_INVALID_BUCKET_STATE
+        try:
+            mode, ts = ol.parse_retention_xml(req.body)
+        except ol.ObjectLockError:
+            raise s3err.ERR_INVALID_RETENTION_MODE
+        except Exception:
+            raise s3err.ERR_MALFORMED_XML
+        import time as _time
+        if ts <= _time.time():
+            raise s3err.ERR_PAST_OBJECT_LOCK_RETAIN_DATE
+        try:
+            ol.check_retention_update(info.metadata, mode, ts,
+                                      self._can_bypass_governance(req))
+        except ol.ObjectLockError:
+            raise s3err.ERR_OBJECT_LOCKED
+        self.layer.update_object_metadata(
+            req.bucket, req.key,
+            {ol.META_MODE: mode, ol.META_RETAIN_UNTIL: ol.iso8601(ts)},
+            version_id)
+        return S3Response(200)
+
+    def object_legal_hold(self, req: S3Request) -> S3Response:
+        from ..bucket import objectlock as ol
+        version_id = self._version_param(req)
+        try:
+            info = self.layer.get_object_info(req.bucket, req.key,
+                                              version_id)
+        except (ObjectNotFound, BucketNotFound):
+            raise s3err.ERR_NO_SUCH_KEY
+        except MethodNotAllowed:
+            raise s3err.ERR_METHOD_NOT_ALLOWED
+        if req.method == "GET":
+            status = info.metadata.get(ol.META_LEGAL_HOLD, "")
+            if not status:
+                raise s3err.ERR_NO_SUCH_RETENTION
+            root = Element("LegalHold", S3_XMLNS)
+            root.child("Status", status)
+            return S3Response(200, root.tobytes(),
+                              {"Content-Type": "application/xml"})
+        if not self._lock_config(req.bucket).enabled:
+            raise s3err.ERR_INVALID_BUCKET_STATE
+        try:
+            status = ol.parse_legal_hold_xml(req.body)
+        except ol.ObjectLockError:
+            raise s3err.ERR_MALFORMED_XML
+        except Exception:
+            raise s3err.ERR_MALFORMED_XML
+        self.layer.update_object_metadata(
+            req.bucket, req.key, {ol.META_LEGAL_HOLD: status}, version_id)
+        return S3Response(200)
+
     def delete_object(self, req: S3Request) -> S3Response:
         version_id = self._version_param(req)
+        self._check_version_delete_allowed(
+            req.bucket, req.key, version_id,
+            self._can_bypass_governance(req))
         h = {}
         try:
             deleted = self.layer.delete_object(
@@ -1336,6 +1520,12 @@ class S3Server:
                         else "s3:GetObjectTagging"), resource
             return ("s3:PutObjectVersionTagging" if "versionId" in p
                     else "s3:PutObjectTagging"), resource
+        if "retention" in p:
+            return ("s3:GetObjectRetention" if m == "GET"
+                    else "s3:PutObjectRetention"), resource
+        if "legal-hold" in p:
+            return ("s3:GetObjectLegalHold" if m == "GET"
+                    else "s3:PutObjectLegalHold"), resource
         if "uploadId" in p or "uploads" in p:
             if m == "DELETE":
                 return "s3:AbortMultipartUpload", resource
@@ -1363,6 +1553,14 @@ class S3Server:
         ctx = {"s3:prefix": req.params.get("prefix", "")}
         if not self.iam.is_allowed(access_key, action, resource, ctx):
             raise s3err.ERR_ACCESS_DENIED
+        # Governance bypass is itself a grant (ref
+        # enforceRetentionBypassForDelete permission check).
+        from ..bucket.objectlock import H_BYPASS_GOVERNANCE
+        if req.headers.get(H_BYPASS_GOVERNANCE, "").lower() == "true":
+            if not self.iam.is_allowed(
+                    access_key, "s3:BypassGovernanceRetention", resource,
+                    ctx):
+                raise s3err.ERR_ACCESS_DENIED
         # CopyObject additionally reads the source: require GetObject
         # on it (ref CopyObjectHandler source auth).
         if req.method == "PUT" and req.key and \
@@ -1429,6 +1627,10 @@ class S3Server:
             raise s3err.ERR_METHOD_NOT_ALLOWED
         if "tagging" in p:
             return h.object_tagging(req)
+        if "retention" in p:
+            return h.object_retention(req)
+        if "legal-hold" in p:
+            return h.object_legal_hold(req)
         if m == "POST" and "select" in p:
             return h.select_object_content(req)
         if m == "POST" and "uploads" in p:

@@ -1,0 +1,270 @@
+"""Object lock (WORM): retention modes, legal hold, bucket defaults,
+delete enforcement (ref pkg/bucket/object/lock semantics, enforcement
+cmd/bucket-object-lock.go; S3 API PutObjectRetention/LegalHold)."""
+
+import time
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from minio_tpu.bucket import objectlock as ol
+from minio_tpu.erasure.engine import ErasureObjects
+from minio_tpu.s3.client import S3Client
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.xl import XLStorage
+
+ACCESS, SECRET = "lockadmin", "lockadmin-secret"
+LOCK_HDR = {"x-amz-bucket-object-lock-enabled": "true"}
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    root = tmp_path_factory.mktemp("lockdisks")
+    disks = [XLStorage(str(root / f"d{i}")) for i in range(4)]
+    layer = ErasureObjects(disks, block_size=64 * 1024)
+    srv = S3Server(layer, ACCESS, SECRET)
+    port = srv.start()
+    yield srv, port
+    srv.stop()
+
+
+@pytest.fixture
+def client(server):
+    _, port = server
+    return S3Client("127.0.0.1", port, ACCESS, SECRET)
+
+
+def _retention_xml(mode: str, until: float) -> bytes:
+    return (f"<Retention><Mode>{mode}</Mode><RetainUntilDate>"
+            f"{ol.iso8601(until)}</RetainUntilDate></Retention>").encode()
+
+
+def _version_of(resp) -> str:
+    return resp.headers["x-amz-version-id"]
+
+
+def test_lock_requires_bucket_enabled(client):
+    client.make_bucket("nolock")
+    r = client.put_object("nolock", "a", b"x", headers={
+        ol.META_MODE: "COMPLIANCE",
+        ol.META_RETAIN_UNTIL: ol.iso8601(time.time() + 3600)})
+    assert r.status == 409  # InvalidBucketState
+
+
+def test_lock_enabled_bucket_enables_versioning(client):
+    r = client.request("PUT", "/lockver", headers=LOCK_HDR)
+    assert r.status == 200
+    r = client.request("GET", "/lockver", query="versioning")
+    assert b"Enabled" in r.body
+    r = client.request("GET", "/lockver", query="object-lock")
+    assert b"ObjectLockEnabled" in r.body
+
+
+def test_compliance_blocks_version_delete(client):
+    client.request("PUT", "/comp", headers=LOCK_HDR)
+    until = time.time() + 3600
+    r = client.put_object("comp", "w.txt", b"worm", headers={
+        ol.META_MODE: "COMPLIANCE", ol.META_RETAIN_UNTIL:
+        ol.iso8601(until)})
+    assert r.status == 200
+    vid = _version_of(r)
+    # Plain delete (marker) is allowed.
+    assert client.delete_object("comp", "w.txt").status == 204
+    # Versioned delete of the data version is WORM-blocked.
+    r = client.request("DELETE", "/comp/w.txt", query=f"versionId={vid}")
+    assert r.status == 403
+    # Even with the governance-bypass header.
+    r = client.request("DELETE", "/comp/w.txt", query=f"versionId={vid}",
+                       headers={ol.H_BYPASS_GOVERNANCE: "true"})
+    assert r.status == 403
+    # The version is still readable.
+    r = client.get_object("comp", "w.txt", query=f"versionId={vid}")
+    assert r.status == 200 and r.body == b"worm"
+
+
+def test_governance_bypass(client):
+    client.request("PUT", "/gov", headers=LOCK_HDR)
+    r = client.put_object("gov", "g.txt", b"gov", headers={
+        ol.META_MODE: "GOVERNANCE", ol.META_RETAIN_UNTIL:
+        ol.iso8601(time.time() + 3600)})
+    vid = _version_of(r)
+    r = client.request("DELETE", "/gov/g.txt", query=f"versionId={vid}")
+    assert r.status == 403
+    r = client.request("DELETE", "/gov/g.txt", query=f"versionId={vid}",
+                       headers={ol.H_BYPASS_GOVERNANCE: "true"})
+    assert r.status == 204
+    assert client.get_object("gov", "g.txt",
+                             query=f"versionId={vid}").status == 404
+
+
+def test_retention_api_roundtrip(client):
+    client.request("PUT", "/retapi", headers=LOCK_HDR)
+    r = client.put_object("retapi", "r.txt", b"r")
+    vid = _version_of(r)
+    # No retention yet.
+    assert client.get_object("retapi", "r.txt",
+                             query="retention").status == 404
+    until = time.time() + 1800
+    r = client.request("PUT", "/retapi/r.txt", query="retention",
+                       body=_retention_xml("GOVERNANCE", until))
+    assert r.status == 200, r.body
+    r = client.get_object("retapi", "r.txt", query="retention")
+    assert r.status == 200
+    doc = ET.fromstring(r.body)
+    ns = {"s3": "http://s3.amazonaws.com/doc/2006-03-01/"}
+    assert doc.findtext("s3:Mode", namespaces=ns) == "GOVERNANCE"
+    # Extending GOVERNANCE retention needs no bypass; shortening does.
+    r = client.request("PUT", "/retapi/r.txt", query="retention",
+                       body=_retention_xml("GOVERNANCE", until + 3600))
+    assert r.status == 200
+    r = client.request("PUT", "/retapi/r.txt", query="retention",
+                       body=_retention_xml("GOVERNANCE", until + 60))
+    assert r.status == 403
+    r = client.request("PUT", "/retapi/r.txt", query="retention",
+                       headers={ol.H_BYPASS_GOVERNANCE: "true"},
+                       body=_retention_xml("GOVERNANCE", until + 60))
+    assert r.status == 200
+    # Versioned delete blocked; works after bypass.
+    r = client.request("DELETE", "/retapi/r.txt",
+                       query=f"versionId={vid}")
+    assert r.status == 403
+
+
+def test_compliance_cannot_shorten(client):
+    client.request("PUT", "/compshort", headers=LOCK_HDR)
+    until = time.time() + 3600
+    client.put_object("compshort", "c.txt", b"c", headers={
+        ol.META_MODE: "COMPLIANCE",
+        ol.META_RETAIN_UNTIL: ol.iso8601(until)})
+    r = client.request("PUT", "/compshort/c.txt", query="retention",
+                       body=_retention_xml("COMPLIANCE", until - 1800))
+    assert r.status == 403
+    r = client.request("PUT", "/compshort/c.txt", query="retention",
+                       body=_retention_xml("GOVERNANCE", until + 3600))
+    assert r.status == 403  # downgrade forbidden
+    r = client.request("PUT", "/compshort/c.txt", query="retention",
+                       body=_retention_xml("COMPLIANCE", until + 3600))
+    assert r.status == 200  # extension ok
+
+
+def test_legal_hold(client):
+    client.request("PUT", "/hold", headers=LOCK_HDR)
+    r = client.put_object("hold", "h.txt", b"h",
+                          headers={ol.META_LEGAL_HOLD: "ON"})
+    vid = _version_of(r)
+    r = client.get_object("hold", "h.txt", query="legal-hold")
+    assert r.status == 200 and b"ON" in r.body
+    # Hold blocks versioned delete regardless of retention/bypass.
+    r = client.request("DELETE", "/hold/h.txt", query=f"versionId={vid}",
+                       headers={ol.H_BYPASS_GOVERNANCE: "true"})
+    assert r.status == 403
+    # Lift the hold -> delete succeeds.
+    r = client.request("PUT", "/hold/h.txt", query="legal-hold",
+                       body=b"<LegalHold><Status>OFF</Status></LegalHold>")
+    assert r.status == 200
+    r = client.request("DELETE", "/hold/h.txt", query=f"versionId={vid}")
+    assert r.status == 204
+
+
+def test_bucket_default_retention(client):
+    client.request("PUT", "/defret", headers=LOCK_HDR)
+    cfg = (b"<ObjectLockConfiguration>"
+           b"<ObjectLockEnabled>Enabled</ObjectLockEnabled>"
+           b"<Rule><DefaultRetention><Mode>GOVERNANCE</Mode>"
+           b"<Days>1</Days></DefaultRetention></Rule>"
+           b"</ObjectLockConfiguration>")
+    assert client.request("PUT", "/defret", query="object-lock",
+                          body=cfg).status == 200
+    r = client.put_object("defret", "d.txt", b"d")  # no lock headers
+    vid = _version_of(r)
+    r = client.get_object("defret", "d.txt", query="retention")
+    assert r.status == 200 and b"GOVERNANCE" in r.body
+    r = client.request("DELETE", "/defret/d.txt", query=f"versionId={vid}")
+    assert r.status == 403
+
+
+def test_expired_retention_allows_delete(server, client):
+    """The API refuses past dates, so stamp an already-expired
+    retention straight into xl.meta and confirm enforcement lapses."""
+    srv, _ = server
+    client.request("PUT", "/expired", headers=LOCK_HDR)
+    r = client.put_object("expired", "e.txt", b"e")
+    vid = _version_of(r)
+    srv.layer.update_object_metadata(
+        "expired", "e.txt",
+        {ol.META_MODE: "GOVERNANCE",
+         ol.META_RETAIN_UNTIL: ol.iso8601(time.time() - 10)}, vid)
+    r = client.request("DELETE", "/expired/e.txt",
+                       query=f"versionId={vid}")
+    assert r.status == 204
+
+
+def test_unit_config_parse():
+    cfg = ol.ObjectLockConfig.from_xml(ol.ENABLED_XML)
+    assert cfg.enabled and cfg.default is None
+    cfg = ol.ObjectLockConfig.from_xml(
+        "<ObjectLockConfiguration>"
+        "<ObjectLockEnabled>Enabled</ObjectLockEnabled>"
+        "<Rule><DefaultRetention><Mode>COMPLIANCE</Mode><Years>1</Years>"
+        "</DefaultRetention></Rule></ObjectLockConfiguration>")
+    assert cfg.default.mode == "COMPLIANCE"
+    assert cfg.default.seconds == 365 * 86400
+    with pytest.raises(ol.ObjectLockError):
+        ol.ObjectLockConfig.from_xml(
+            "<ObjectLockConfiguration><Rule><DefaultRetention>"
+            "<Mode>COMPLIANCE</Mode><Days>1</Days><Years>1</Years>"
+            "</DefaultRetention></Rule></ObjectLockConfiguration>")
+
+
+def test_unit_enforcement():
+    now = time.time()
+    live = {ol.META_MODE: "COMPLIANCE",
+            ol.META_RETAIN_UNTIL: ol.iso8601(now + 100)}
+    with pytest.raises(ol.ObjectLockError):
+        ol.check_version_delete(live, bypass_governance=True, now=now)
+    expired = {ol.META_MODE: "COMPLIANCE",
+               ol.META_RETAIN_UNTIL: ol.iso8601(now - 100)}
+    ol.check_version_delete(expired, bypass_governance=False, now=now)
+    gov = {ol.META_MODE: "GOVERNANCE",
+           ol.META_RETAIN_UNTIL: ol.iso8601(now + 100)}
+    with pytest.raises(ol.ObjectLockError):
+        ol.check_version_delete(gov, bypass_governance=False, now=now)
+    ol.check_version_delete(gov, bypass_governance=True, now=now)
+    held = {ol.META_LEGAL_HOLD: "ON"}
+    with pytest.raises(ol.ObjectLockError):
+        ol.check_version_delete(held, bypass_governance=True, now=now)
+
+
+def test_lock_config_cannot_be_removed(client):
+    """WORM escape hatches must be closed: no DELETE of the lock
+    config, no enabling on non-lock buckets, no versioning
+    suspension."""
+    client.request("PUT", "/escape", headers=LOCK_HDR)
+    r = client.request("DELETE", "/escape", query="object-lock")
+    assert r.status == 405
+    r = client.request(
+        "PUT", "/escape", query="versioning",
+        body=b"<VersioningConfiguration><Status>Suspended</Status>"
+             b"</VersioningConfiguration>")
+    assert r.status == 409
+    # PUT lock config on a bucket NOT created with lock -> 409.
+    client.make_bucket("neverlock")
+    r = client.request("PUT", "/neverlock", query="object-lock",
+                       body=ol.ENABLED_XML.encode())
+    assert r.status == 409
+
+
+def test_copy_does_not_inherit_lock(client):
+    client.request("PUT", "/copysrc", headers=LOCK_HDR)
+    client.make_bucket("copydst")
+    client.put_object("copysrc", "locked.txt", b"data", headers={
+        ol.META_MODE: "COMPLIANCE",
+        ol.META_RETAIN_UNTIL: ol.iso8601(time.time() + 3600),
+        ol.META_LEGAL_HOLD: "ON"})
+    r = client.request("PUT", "/copydst/copy.txt",
+                       headers={"x-amz-copy-source": "/copysrc/locked.txt"})
+    assert r.status == 200
+    # Destination carries no WORM state and is deletable.
+    assert client.get_object("copydst", "copy.txt",
+                             query="retention").status == 404
+    assert client.delete_object("copydst", "copy.txt").status == 204
