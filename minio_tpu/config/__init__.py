@@ -1,0 +1,1 @@
+"""Configuration subsystems (ref cmd/config/ tree)."""

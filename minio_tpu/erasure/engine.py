@@ -105,6 +105,7 @@ class ErasureObjects:
         self.m = parity_shards
         self.block_size = block_size
         self.codec = Erasure(data_shards, parity_shards, block_size)
+        self._codec_cache: dict[tuple[int, int], Erasure] = {}
         from ..parallel.nslock import LocalNSLock
         from .heal import Healer, MRFQueue
         from .multipart import MultipartUploads
@@ -195,16 +196,37 @@ class ErasureObjects:
     # ------------------------------------------------------------------
     # write path
 
+    def codec_for(self, k: int, m: int, block_size: int | None = None):
+        """Codec for a per-object geometry (storage class may override
+        the set default parity, ref GetParityForSC,
+        cmd/config/storageclass/storage-class.go; old objects may also
+        carry a different block size)."""
+        bs = self.block_size if block_size is None else block_size
+        if (k, m, bs) == (self.k, self.m, self.block_size):
+            return self.codec
+        key = (k, m, bs)
+        codec = self._codec_cache.get(key)
+        if codec is None:
+            codec = Erasure(k, m, bs)
+            self._codec_cache[key] = codec
+        return codec
+
     def put_object(self, bucket: str, object_name: str, data: bytes,
                    metadata: dict | None = None,
-                   versioned: bool = False) -> ObjectInfo:
+                   versioned: bool = False,
+                   parity_shards: int | None = None) -> ObjectInfo:
         self._check_bucket(bucket)
         data = bytes(data)
         n = len(self.disks)
+        m = self.m if parity_shards is None else parity_shards
+        if not (0 < m <= n // 2):
+            raise ValueError(f"parity {m} out of range for {n} disks")
+        k = n - m
+        codec = self.codec_for(k, m)
         distribution = hash_order(f"{bucket}/{object_name}", n)
-        wq = write_quorum(self.k, self.m)
+        wq = write_quorum(k, m)
 
-        shard_streams = self._encode_object(data)
+        shard_streams = self._encode_object(data, k, m, codec)
 
         version_id = new_version_id() if versioned else ""
         data_dir = new_data_dir()
@@ -232,7 +254,7 @@ class ErasureObjects:
                     size=len(data), mod_time=mod_time, metadata=meta,
                     parts=[part],
                     erasure=ErasureInfo(
-                        data_blocks=self.k, parity_blocks=self.m,
+                        data_blocks=k, parity_blocks=m,
                         block_size=self.block_size, index=distribution[i],
                         distribution=list(distribution),
                         checksums=[{"part": 1,
@@ -268,13 +290,18 @@ class ErasureObjects:
                           version_id=version_id, metadata=meta,
                           parts=[part])
 
-    def _encode_object(self, data: bytes) -> list[bytes]:
+    def _encode_object(self, data: bytes, k: int | None = None,
+                       m: int | None = None,
+                       codec=None) -> list[bytes]:
         """Encode all stripe blocks (batched TPU dispatch for the full
         blocks) and return the k+m bitrot-wrapped shard streams."""
-        n = self.k + self.m
+        k = self.k if k is None else k
+        m = self.m if m is None else m
+        codec = self.codec if codec is None else codec
+        n = k + m
         if len(data) == 0:
             return [b""] * n
-        shard_size = self.codec.shard_size()
+        shard_size = codec.shard_size()
         raw_shards: list[bytearray] = [bytearray() for _ in range(n)]
 
         nfull = len(data) // self.block_size
@@ -285,18 +312,18 @@ class ErasureObjects:
             full = np.frombuffer(
                 data[:nfull * self.block_size], dtype=np.uint8,
             ).reshape(nfull, self.block_size)
-            if self.block_size != self.k * shard_size:
-                padded = np.zeros((nfull, self.k * shard_size),
+            if self.block_size != k * shard_size:
+                padded = np.zeros((nfull, k * shard_size),
                                   dtype=np.uint8)
                 padded[:, :self.block_size] = full
                 full = padded
-            full = full.reshape(nfull, self.k, shard_size)
-            encoded = self.codec.encode_blocks_batch(full)
+            full = full.reshape(nfull, k, shard_size)
+            encoded = codec.encode_blocks_batch(full)
             for j in range(n):
                 raw_shards[j] += encoded[:, j, :].tobytes()
         rest = data[nfull * self.block_size:]
         if rest:
-            shards = self.codec.encode_data(rest)
+            shards = codec.encode_data(rest)
             for j in range(n):
                 raw_shards[j] += shards[j].tobytes()
 
@@ -422,8 +449,7 @@ class ErasureObjects:
         by_shard = self._shard_readers(fi, agreed)
         # Codec geometry comes from the object's metadata (it may differ
         # from this engine's default).
-        codec = self.codec if (k, m) == (self.k, self.m) else \
-            Erasure(k, m, fi.erasure.block_size)
+        codec = self.codec_for(k, m, fi.erasure.block_size)
 
         # Block coverage of [offset, offset+length).
         start_block = offset // fi.erasure.block_size

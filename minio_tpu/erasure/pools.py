@@ -18,6 +18,15 @@ class ErasureServerPools:
             raise ValueError("need at least one pool")
         self.pools = pools
 
+    @property
+    def k(self) -> int:
+        """First pool's geometry (storage-class parity validation)."""
+        return self.pools[0].k
+
+    @property
+    def m(self) -> int:
+        return self.pools[0].m
+
     # -- placement ------------------------------------------------------
 
     def _pool_free_space(self, pool: ErasureSets) -> int:
@@ -72,11 +81,13 @@ class ErasureServerPools:
 
     def put_object(self, bucket: str, object_name: str, data: bytes,
                    metadata: dict | None = None,
-                   versioned: bool = False) -> ObjectInfo:
+                   versioned: bool = False,
+                   parity_shards: int | None = None) -> ObjectInfo:
         idx = self._put_pool_index(bucket, object_name)
         return self.pools[idx].put_object(bucket, object_name, data,
                                           metadata=metadata,
-                                          versioned=versioned)
+                                          versioned=versioned,
+                                          parity_shards=parity_shards)
 
     def _probe(self, bucket: str, object_name: str, op):
         """Try each pool in order; first hit wins (ref pool probe loop,

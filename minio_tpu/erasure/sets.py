@@ -78,12 +78,22 @@ class ErasureSets:
 
     # -- objects (dispatch to the hashed set) ---------------------------
 
+    @property
+    def k(self) -> int:
+        """Set geometry (uniform across sets; ref formatErasureV3)."""
+        return self.sets[0].k
+
+    @property
+    def m(self) -> int:
+        return self.sets[0].m
+
     def put_object(self, bucket: str, object_name: str, data: bytes,
                    metadata: dict | None = None,
-                   versioned: bool = False) -> ObjectInfo:
+                   versioned: bool = False,
+                   parity_shards: int | None = None) -> ObjectInfo:
         return self.set_for(object_name).put_object(
             bucket, object_name, data, metadata=metadata,
-            versioned=versioned)
+            versioned=versioned, parity_shards=parity_shards)
 
     def get_object(self, bucket: str, object_name: str, offset: int = 0,
                    length: int = -1, version_id: str = ""):

@@ -141,7 +141,9 @@ class FSObjects:
 
     def put_object(self, bucket: str, object_name: str, data: bytes,
                    metadata: dict | None = None,
-                   versioned: bool = False) -> ObjectInfo:
+                   versioned: bool = False,
+                   parity_shards: int | None = None) -> ObjectInfo:
+        # parity_shards is an EC knob; a single POSIX disk has no shards.
         if versioned:
             # ref cmd/fs-v1.go:1090: versioned PUT -> NotImplemented
             raise MethodNotAllowed("FS backend does not support versioning")

@@ -241,6 +241,23 @@ class AdminHandlers:
                     "dangling": r.dangling})
         return {"items": results}
 
+    # -- bucket quota (ref PutBucketQuotaConfigHandler,
+    # cmd/admin-bucket-handlers.go) ------------------------------------
+
+    def h_set_bucket_quota(self, p, body):
+        doc = json.loads(body) if body else {}
+        bm = self.server.bucket_meta
+        if not doc.get("quota"):
+            bm.update(p["bucket"], quota=None)  # clear
+        else:
+            bm.update(p["bucket"], quota={
+                "quota": int(doc["quota"]),
+                "quotaType": doc.get("quotaType", "hard")})
+        return {"ok": True}
+
+    def h_get_bucket_quota(self, p, body):
+        return self.server.bucket_meta.get(p["bucket"]).quota or {}
+
     # -- replication remote targets (ref SetRemoteTargetHandler etc.,
     # cmd/admin-bucket-handlers.go) ------------------------------------
 

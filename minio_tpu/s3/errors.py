@@ -155,3 +155,7 @@ ERR_INVALID_RETENTION_MODE = _e(
 ERR_NO_SUCH_RETENTION = _e(
     "NoSuchObjectLockConfiguration",
     "The specified object does not have a ObjectLock configuration", 404)
+ERR_INVALID_STORAGE_CLASS = _e(
+    "InvalidStorageClass", "Invalid storage class.", 400)
+ERR_QUOTA_EXCEEDED = _e(
+    "QuotaExceeded", "Bucket quota exceeded", 409)

@@ -118,6 +118,54 @@ class S3ApiHandlers:
         from ..bucket.replication import ReplicationPool
         self.replication = ReplicationPool(
             self.bucket_meta, self.read_for_replication, layer)
+        from ..config.storageclass import StorageClassConfig
+        self.storage_class = StorageClassConfig.from_env()
+        self._usage_cache: dict[str, tuple[float, int]] = {}
+
+    # ---------------- storage class / quota ----------------
+
+    def _parity_for_request(self, req: S3Request) -> int | None:
+        """Parity override from x-amz-storage-class (ref the
+        GetParityForSC call in putObject, cmd/erasure-object.go:597);
+        None = layer default (also for FS, which has no shards)."""
+        from ..config import storageclass as sc
+        sc_hdr = req.headers.get("x-amz-storage-class", "")
+        n = getattr(self.layer, "k", 0) + getattr(self.layer, "m", 0)
+        if n < 2:
+            if sc_hdr and sc_hdr not in (sc.STANDARD, sc.RRS):
+                raise s3err.ERR_INVALID_STORAGE_CLASS
+            return None
+        try:
+            return self.storage_class.parity_for(
+                sc_hdr, n, getattr(self.layer, "m", 0))
+        except sc.InvalidStorageClass:
+            raise s3err.ERR_INVALID_STORAGE_CLASS
+
+    def _bucket_usage(self, bucket: str) -> int:
+        """Total logical bytes in the bucket, cached briefly (the
+        reference uses the crawler's dataUsageCache for the same check,
+        ref enforceBucketQuota, cmd/bucket-quota.go)."""
+        hit = self._usage_cache.get(bucket)
+        if hit and time.time() - hit[0] < 2.0:
+            return hit[1]
+        meta = self.bucket_meta.get(bucket)
+        if meta.versioning:  # every stored version consumes quota
+            infos = self.layer.list_object_versions(bucket,
+                                                    max_keys=1_000_000)
+        else:
+            infos = self.layer.list_objects(bucket, max_keys=1_000_000)
+        total = sum(i.size for i in infos)
+        self._usage_cache[bucket] = (time.time(), total)
+        return total
+
+    def _check_quota(self, bucket: str, incoming: int) -> None:
+        q = self.bucket_meta.get(bucket).quota
+        if not q or not q.get("quota"):
+            return
+        if q.get("quotaType", "hard") != "hard":
+            return  # FIFO/soft quotas don't reject writes
+        if self._bucket_usage(bucket) + incoming > int(q["quota"]):
+            raise s3err.ERR_QUOTA_EXCEEDED
 
     # ---------------- replication plumbing ----------------
 
@@ -217,6 +265,9 @@ class S3ApiHandlers:
             # Lock can only be enabled at creation; it force-enables
             # versioning (ref MakeBucketWithObjectLock,
             # cmd/bucket-handlers.go).
+            if not getattr(self.layer, "supports_versioning", True):
+                self.layer.delete_bucket(req.bucket)
+                raise s3err.ERR_NOT_IMPLEMENTED  # FS: no versioning
             from ..bucket import objectlock as ol
             self.bucket_meta.update(req.bucket,
                                     object_lock_xml=ol.ENABLED_XML,
@@ -312,7 +363,8 @@ class S3ApiHandlers:
             c.child("LastModified", _iso8601(info.mod_time))
             c.child("ETag", f'"{info.etag}"')
             c.child("Size", self._actual_size(info))
-            c.child("StorageClass", "STANDARD")
+            c.child("StorageClass", info.metadata.get(
+                "x-amz-storage-class", "STANDARD"))
         for cp in common:
             p = root.child("CommonPrefixes")
             p.child("Prefix", cp)
@@ -580,13 +632,19 @@ class S3ApiHandlers:
         if "x-amz-tagging" in req.headers:
             meta["x-amz-tagging"] = req.headers["x-amz-tagging"]
         self._apply_lock_headers(req, meta)
+        parity = self._parity_for_request(req)
+        if req.headers.get("x-amz-storage-class"):
+            meta["x-amz-storage-class"] = req.headers[
+                "x-amz-storage-class"]
+        self._check_quota(req.bucket, len(req.body))
         body = self._maybe_compress(req.key, req.body, meta)
         body = self._sse_encrypt_body(req, body, meta)
         self._replication_decision(req, meta)
         try:
             info = self.layer.put_object(
                 req.bucket, req.key, body, metadata=meta,
-                versioned=self._versioned(req.bucket))
+                versioned=self._versioned(req.bucket),
+                parity_shards=parity)
         except BucketNotFound:
             raise s3err.ERR_NO_SUCH_BUCKET
         except MethodNotAllowed:
@@ -633,6 +691,7 @@ class S3ApiHandlers:
                   ol.META_RETAIN_UNTIL, ol.META_LEGAL_HOLD, "etag"):
             meta.pop(k, None)
         self._apply_lock_headers(req, meta)
+        self._check_quota(req.bucket, len(data))
         data = self._maybe_compress(req.key, data, meta)
         data = self._sse_encrypt_body(req, data, meta)
         self._replication_decision(req, meta)
@@ -829,6 +888,7 @@ class S3ApiHandlers:
             if hashlib.md5(req.body).digest() != base64.b64decode(
                     md5_header):
                 raise s3err.ERR_BAD_DIGEST
+        self._check_quota(req.bucket, len(req.body))
         body, actual = req.body, None
         part_number = int(req.params["partNumber"])
         pkey = self._sse_part_key(req, part_number)
@@ -858,6 +918,10 @@ class S3ApiHandlers:
         except Exception:
             raise s3err.ERR_MALFORMED_XML
         try:
+            staged = self.layer.multipart.list_parts(
+                req.bucket, req.key, req.params["uploadId"])
+            self._check_quota(req.bucket,
+                              sum(p["size"] for p in staged))
             info = self.layer.multipart.complete_multipart_upload(
                 req.bucket, req.key, req.params["uploadId"], parts)
         except UploadNotFound:
@@ -1447,6 +1511,22 @@ class S3Server:
             ak = sigv4.verify_header_auth(
                 req.method, req.raw_path, req.query, req.headers,
                 hashlib.sha256(req.body).hexdigest(), self._lookup_secret)
+            # aws-chunked streaming upload: the seed signature just
+            # verified chains the per-chunk signatures; decode + verify
+            # the payload in place (ref newSignV4ChunkedReader,
+            # cmd/streaming-signature-v4.go:156).
+            if req.headers.get("x-amz-content-sha256",
+                               "") == sigv4.STREAMING_PAYLOAD:
+                cred, _, seed = sigv4.parse_auth_fields(req.headers)
+                req.body = sigv4.decode_streaming(
+                    req.body, self._lookup_secret(ak), cred,
+                    req.headers.get("x-amz-date", ""), seed)
+                want = req.headers.get("x-amz-decoded-content-length")
+                try:
+                    if want and int(want) != len(req.body):
+                        raise s3err.ERR_SIGNATURE_DOES_NOT_MATCH
+                except ValueError:
+                    raise s3err.ERR_INVALID_ARGUMENT
         elif "X-Amz-Signature" in req.params:
             ak = sigv4.verify_presigned(
                 req.method, req.raw_path, req.query, req.headers,

@@ -233,3 +233,128 @@ def presign_url(method: str, host: str, path: str, access_key: str,
     sig = hmac.new(_signing_key(secret_key, date, region, "s3"),
                    sts.encode(), hashlib.sha256).hexdigest()
     return (f"http://{host}{path}?{query}&X-Amz-Signature={sig}")
+
+
+# --- streaming aws-chunked (ref cmd/streaming-signature-v4.go) ---------------
+
+STREAMING_ALGORITHM = "AWS4-HMAC-SHA256-PAYLOAD"
+_EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
+
+
+def parse_auth_fields(headers: dict[str, str]) -> tuple[Credential,
+                                                        list[str], str]:
+    """(credential, signed_headers, signature) from an Authorization
+    header (ref parseSignV4, cmd/signature-v4-parser.go)."""
+    auth = headers.get("authorization", "")
+    if not auth.startswith(SIGN_V4_ALGORITHM):
+        raise ERR_MISSING_AUTH
+    fields = {}
+    for item in auth[len(SIGN_V4_ALGORITHM):].split(","):
+        item = item.strip()
+        if "=" not in item:
+            raise ERR_AUTHORIZATION_HEADER_MALFORMED
+        k, v = item.split("=", 1)
+        fields[k.strip()] = v.strip()
+    try:
+        return (_parse_credential(fields["Credential"]),
+                fields["SignedHeaders"].split(";"), fields["Signature"])
+    except KeyError:
+        raise ERR_AUTHORIZATION_HEADER_MALFORMED
+
+
+def _chunk_string_to_sign(amz_date: str, scope: str, prev_sig: str,
+                          chunk: bytes) -> str:
+    return "\n".join([
+        STREAMING_ALGORITHM, amz_date, scope, prev_sig, _EMPTY_SHA256,
+        hashlib.sha256(chunk).hexdigest(),
+    ])
+
+
+def decode_streaming(body: bytes, secret: str, cred: Credential,
+                     amz_date: str, seed_signature: str) -> bytes:
+    """Decode + verify an aws-chunked body: each chunk's signature
+    chains off the previous one, seeded by the header signature (ref
+    newSignV4ChunkedReader, cmd/streaming-signature-v4.go:156)."""
+    key = _signing_key(secret, cred.date, cred.region, cred.service)
+    out = bytearray()
+    prev = seed_signature
+    pos = 0
+    while True:
+        nl = body.find(b"\r\n", pos)
+        if nl < 0:
+            raise ERR_SIGNATURE_DOES_NOT_MATCH
+        header = body[pos:nl].decode("ascii", "replace")
+        size_s, _, ext = header.partition(";")
+        try:
+            size = int(size_s, 16)
+        except ValueError:
+            raise ERR_SIGNATURE_DOES_NOT_MATCH
+        sig = ""
+        for kv in ext.split(";"):
+            k, _, v = kv.partition("=")
+            if k.strip() == "chunk-signature":
+                sig = v.strip()
+        data = body[nl + 2:nl + 2 + size]
+        if len(data) != size:
+            raise ERR_SIGNATURE_DOES_NOT_MATCH
+        want = hmac.new(
+            key, _chunk_string_to_sign(amz_date, cred.scope, prev,
+                                       data).encode(),
+            hashlib.sha256).hexdigest()
+        if not hmac.compare_digest(want, sig):
+            raise ERR_SIGNATURE_DOES_NOT_MATCH
+        prev = want
+        pos = nl + 2 + size
+        if body[pos:pos + 2] == b"\r\n":
+            pos += 2
+        if size == 0:
+            break
+        out += data
+    return bytes(out)
+
+
+def sign_streaming_request(method: str, path: str, query: str,
+                           headers: dict[str, str], body: bytes,
+                           access_key: str, secret_key: str,
+                           region: str = "us-east-1",
+                           chunk_size: int = 64 * 1024,
+                           amz_time: float | None = None,
+                           ) -> tuple[dict[str, str], bytes]:
+    """Client side: produce (headers, aws-chunked body) for a streaming
+    upload (what aws-sdk/mc send for large PUTs)."""
+    t = time.gmtime(amz_time if amz_time is not None else time.time())
+    amz_date = time.strftime("%Y%m%dT%H%M%SZ", t)
+    date = time.strftime("%Y%m%d", t)
+    out = {k.lower(): v for k, v in headers.items()}
+    out["x-amz-date"] = amz_date
+    out["x-amz-content-sha256"] = STREAMING_PAYLOAD
+    out["content-encoding"] = "aws-chunked"
+    out["x-amz-decoded-content-length"] = str(len(body))
+    signed = sorted(out)
+    cred = Credential(access_key, date, region, "s3")
+    canonical = _canonical_request(method, path, query, out, signed,
+                                   STREAMING_PAYLOAD)
+    sts = _string_to_sign(amz_date, cred.scope, canonical)
+    key = _signing_key(secret_key, date, region, "s3")
+    seed = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+    out["authorization"] = (
+        f"{SIGN_V4_ALGORITHM} Credential={cred.access_key}/{cred.scope}, "
+        f"SignedHeaders={';'.join(signed)}, Signature={seed}")
+
+    chunks = []
+    prev = seed
+    for off in range(0, len(body), chunk_size):
+        part = body[off:off + chunk_size]
+        sig = hmac.new(key, _chunk_string_to_sign(
+            amz_date, cred.scope, prev, part).encode(),
+            hashlib.sha256).hexdigest()
+        chunks.append(f"{len(part):x};chunk-signature={sig}\r\n".encode()
+                      + part + b"\r\n")
+        prev = sig
+    final = hmac.new(key, _chunk_string_to_sign(
+        amz_date, cred.scope, prev, b"").encode(),
+        hashlib.sha256).hexdigest()
+    chunks.append(f"0;chunk-signature={final}\r\n\r\n".encode())
+    wire = b"".join(chunks)
+    out["content-length"] = str(len(wire))
+    return out, wire

@@ -1,0 +1,227 @@
+"""Storage class (per-request parity), bucket quota enforcement, and
+streaming aws-chunked SigV4 uploads (ref
+cmd/config/storageclass/storage-class.go, cmd/bucket-quota.go,
+cmd/streaming-signature-v4.go)."""
+
+import json
+import time
+
+import pytest
+
+from minio_tpu.config.storageclass import (InvalidStorageClass,
+                                           StorageClassConfig)
+from minio_tpu.erasure.engine import ErasureObjects
+from minio_tpu.s3 import sigv4
+from minio_tpu.s3.client import S3Client
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.xl import XLStorage
+
+ACCESS, SECRET = "scadmin", "scadmin-secret"
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    root = tmp_path_factory.mktemp("scdisks")
+    disks = [XLStorage(str(root / f"d{i}")) for i in range(6)]
+    layer = ErasureObjects(disks, block_size=64 * 1024)
+    srv = S3Server(layer, ACCESS, SECRET)
+    port = srv.start()
+    yield srv, port
+    srv.stop()
+
+
+@pytest.fixture
+def client(server):
+    _, port = server
+    return S3Client("127.0.0.1", port, ACCESS, SECRET)
+
+
+# ---------------------------------------------------------------------------
+# storage class
+# ---------------------------------------------------------------------------
+
+
+def test_parity_table():
+    cfg = StorageClassConfig()
+    assert cfg.parity_for("", 12, 6) == 6
+    assert cfg.parity_for("STANDARD", 12, 6) == 6
+    assert cfg.parity_for("REDUCED_REDUNDANCY", 12, 6) == 2
+    cfg = StorageClassConfig(standard_parity=4, rrs_parity=3)
+    assert cfg.parity_for("STANDARD", 12, 6) == 4
+    assert cfg.parity_for("REDUCED_REDUNDANCY", 12, 6) == 3
+    with pytest.raises(InvalidStorageClass):
+        cfg.parity_for("GLACIER", 12, 6)
+    with pytest.raises(InvalidStorageClass):
+        StorageClassConfig(standard_parity=9).parity_for("STANDARD", 12, 6)
+
+
+def test_rrs_put_uses_reduced_parity(server, client):
+    srv, _ = server
+    client.make_bucket("scb")
+    r = client.put_object("scb", "rrs.bin", b"x" * 5000,
+                          headers={"x-amz-storage-class":
+                                   "REDUCED_REDUNDANCY"})
+    assert r.status == 200
+    # The object's own metadata records k=4,m=2 on a 6-disk set.
+    fi, _ = srv.layer._quorum_file_info("scb", "rrs.bin")
+    assert (fi.erasure.data_blocks, fi.erasure.parity_blocks) == (4, 2)
+    # Round-trips fine and reports its class in listings.
+    g = client.get_object("scb", "rrs.bin")
+    assert g.status == 200 and g.body == b"x" * 5000
+    ls = client.list_objects_v2("scb")
+    assert b"REDUCED_REDUNDANCY" in ls.body
+
+    # STANDARD default stays at the set split (3+3).
+    client.put_object("scb", "std.bin", b"y" * 5000)
+    fi, _ = srv.layer._quorum_file_info("scb", "std.bin")
+    assert (fi.erasure.data_blocks, fi.erasure.parity_blocks) == (3, 3)
+
+
+def test_rrs_object_survives_two_disk_loss(server, client):
+    """RRS on 6 disks = 4+2: still readable with 2 shards gone."""
+    srv, _ = server
+    client.make_bucket("rrsloss")
+    payload = bytes(range(256)) * 500
+    client.put_object("rrsloss", "obj", payload,
+                      headers={"x-amz-storage-class":
+                               "REDUCED_REDUNDANCY"})
+    import shutil
+    for d in srv.layer.disks[:2]:
+        shutil.rmtree(f"{d.root}/rrsloss", ignore_errors=True)
+    g = client.get_object("rrsloss", "obj")
+    assert g.status == 200 and g.body == payload
+
+
+def test_invalid_storage_class_rejected(client):
+    client.make_bucket("scbad")
+    r = client.put_object("scbad", "x", b"x",
+                          headers={"x-amz-storage-class": "GLACIER"})
+    assert r.status == 400
+    assert b"InvalidStorageClass" in r.body
+
+
+# ---------------------------------------------------------------------------
+# quota
+# ---------------------------------------------------------------------------
+
+
+def test_hard_quota_enforced(client):
+    client.make_bucket("quotab")
+    r = client.request("POST", "/minio-tpu/admin/v1/set-bucket-quota",
+                       query="bucket=quotab",
+                       body=json.dumps({"quota": 10_000,
+                                        "quotaType": "hard"}).encode())
+    assert r.status == 200
+    assert client.put_object("quotab", "a", b"x" * 6000).status == 200
+    time.sleep(2.1)  # usage cache TTL
+    r = client.put_object("quotab", "b", b"x" * 6000)
+    assert r.status == 409
+    assert b"QuotaExceeded" in r.body
+    # Under the limit still fits.
+    r = client.put_object("quotab", "c", b"x" * 1000)
+    assert r.status == 200
+    # Clearing the quota lifts enforcement.
+    r = client.request("POST", "/minio-tpu/admin/v1/set-bucket-quota",
+                       query="bucket=quotab", body=b"{}")
+    assert r.status == 200
+    time.sleep(2.1)
+    assert client.put_object("quotab", "d", b"x" * 20000).status == 200
+
+
+# ---------------------------------------------------------------------------
+# streaming aws-chunked
+# ---------------------------------------------------------------------------
+
+
+def _streaming_put(client, bucket, key, body, chunk_size=8192,
+                   tamper=None):
+    import http.client
+    path = f"/{bucket}/{key}"
+    headers = {"host": f"{client.host}:{client.port}",
+               "content-type": "application/octet-stream"}
+    hdrs, wire = sigv4.sign_streaming_request(
+        "PUT", path, "", headers, body, client.access_key,
+        client.secret_key, chunk_size=chunk_size)
+    if tamper:
+        wire = tamper(wire)
+    conn = http.client.HTTPConnection(client.host, client.port, timeout=30)
+    try:
+        conn.request("PUT", path, body=wire, headers=hdrs)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def test_streaming_chunked_put(client):
+    client.make_bucket("streamb")
+    body = bytes(i % 251 for i in range(100_000))
+    status, out = _streaming_put(client, "streamb", "chunked.bin", body)
+    assert status == 200, out
+    g = client.get_object("streamb", "chunked.bin")
+    assert g.status == 200 and g.body == body
+
+
+def test_streaming_empty_body(client):
+    client.make_bucket("streamempty")
+    status, _ = _streaming_put(client, "streamempty", "empty", b"")
+    assert status == 200
+    g = client.get_object("streamempty", "empty")
+    assert g.status == 200 and g.body == b""
+
+
+def test_streaming_tampered_chunk_rejected(client):
+    client.make_bucket("streamtamper")
+    body = b"A" * 20000
+
+    def flip(wire: bytes) -> bytes:
+        # Corrupt one payload byte inside the first chunk without
+        # touching the chunk framing.
+        idx = wire.find(b"\r\n") + 2 + 100
+        return wire[:idx] + bytes([wire[idx] ^ 1]) + wire[idx + 1:]
+
+    status, out = _streaming_put(client, "streamtamper", "bad", body,
+                                 tamper=flip)
+    assert status == 403
+    assert b"SignatureDoesNotMatch" in out
+
+
+def test_streaming_roundtrip_unit():
+    body = b"hello streaming world" * 1000
+    hdrs, wire = sigv4.sign_streaming_request(
+        "PUT", "/b/k", "", {"host": "h"}, body, "AK", "SK",
+        chunk_size=4096)
+    cred, _, seed = sigv4.parse_auth_fields(hdrs)
+    out = sigv4.decode_streaming(wire, "SK", cred,
+                                 hdrs["x-amz-date"], seed)
+    assert out == body
+
+
+def test_parity_override_on_pools_topology(tmp_path):
+    """The production topology (ErasureServerPools -> ErasureSets) must
+    honor storage-class parity, not silently no-op (regression: the
+    k/m probe returned 0 on pools)."""
+    import uuid
+
+    from minio_tpu.erasure.pools import ErasureServerPools
+    from minio_tpu.erasure.sets import ErasureSets
+    disks = [str(tmp_path / f"d{i}") for i in range(6)]
+    sets = ErasureSets([XLStorage(d) for d in disks], sets_layout=[6],
+                       deployment_id=str(uuid.uuid4()),
+                       block_size=64 * 1024)
+    layer = ErasureServerPools([sets])
+    assert (layer.k, layer.m) == (3, 3)
+    srv = S3Server(layer, ACCESS, SECRET)
+    port = srv.start()
+    try:
+        c = S3Client("127.0.0.1", port, ACCESS, SECRET)
+        c.make_bucket("poolsc")
+        r = c.put_object("poolsc", "r.bin", b"z" * 4000,
+                         headers={"x-amz-storage-class":
+                                  "REDUCED_REDUNDANCY"})
+        assert r.status == 200
+        fi, _ = sets.sets[0]._quorum_file_info("poolsc", "r.bin")
+        assert (fi.erasure.data_blocks, fi.erasure.parity_blocks) == (4, 2)
+        assert c.get_object("poolsc", "r.bin").body == b"z" * 4000
+    finally:
+        srv.stop()
