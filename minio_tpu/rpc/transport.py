@@ -23,6 +23,7 @@ import hmac
 import http.client
 import json
 import struct
+import socket
 import threading
 import time
 
@@ -89,10 +90,13 @@ class RPCClient:
 
     def __init__(self, host: str, port: int, cluster_key: bytes,
                  timeout: float = 30.0):
+        from ..utils.dyntimeout import DynamicTimeout
         self.host = host
         self.port = port
         self.cluster_key = cluster_key
-        self.timeout = timeout
+        # Self-tuning timeout: slow peers stretch it, fast ones shrink
+        # it back (ref cmd/dynamic-timeouts.go:35).
+        self.dyn_timeout = DynamicTimeout(timeout, minimum=1.0)
         self._offline_until = 0.0
         self._mu = threading.Lock()
         self._pool: list[http.client.HTTPConnection] = []
@@ -107,12 +111,21 @@ class RPCClient:
         with self._mu:
             self._offline_until = time.monotonic() + self.OFFLINE_RETRY
 
+    @property
+    def timeout(self) -> float:
+        return self.dyn_timeout.timeout
+
     def _get_conn(self) -> http.client.HTTPConnection:
+        t = self.timeout
         with self._mu:
             if self._pool:
-                return self._pool.pop()
+                conn = self._pool.pop()
+                conn.timeout = t  # used on (re)connect
+                if conn.sock is not None:
+                    conn.sock.settimeout(t)
+                return conn
         return http.client.HTTPConnection(self.host, self.port,
-                                          timeout=self.timeout)
+                                          timeout=t)
 
     def _put_conn(self, conn: http.client.HTTPConnection) -> None:
         with self._mu:
@@ -136,11 +149,15 @@ class RPCClient:
             "Content-Length": str(len(body)),
         }
         conn = self._get_conn()
+        t0 = time.monotonic()
+        logged = False
         try:
             conn.request("POST", f"{RPC_PREFIX}/{service}/{method}",
                          body=body, headers=headers)
             resp = conn.getresponse()
             rbody = resp.read()
+            self.dyn_timeout.log_success(time.monotonic() - t0)
+            logged = True
             if resp.status != 200:
                 self._put_conn(conn)
                 raise wire_to_error(resp.status, rbody)
@@ -149,6 +166,11 @@ class RPCClient:
             return json.loads(result_json or b"{}"), data
         except (OSError, http.client.HTTPException, ValueError) as e:
             conn.close()
+            # Only genuine ceiling hits tune the timeout up — an
+            # instant connection-refused says nothing about slowness.
+            if not logged and isinstance(e, (TimeoutError,
+                                             socket.timeout)):
+                self.dyn_timeout.log_failure()
             self._mark_offline()
             raise serr.DiskNotFound(
                 f"{self.endpoint()} unreachable: {e}")

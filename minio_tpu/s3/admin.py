@@ -104,6 +104,7 @@ class AdminHandlers:
 
     def __init__(self, server):
         self.server = server  # S3Server
+        self._heal_seqs: dict[str, dict] = {}
 
     def handle(self, method: str, path: str, params: dict,
                body: bytes, access_key: str) -> tuple[int, bytes]:
@@ -214,32 +215,30 @@ class AdminHandlers:
 
     # -- heal -----------------------------------------------------------
 
-    def h_heal(self, p, body):
-        layer = self.server.layer
-        bucket = p.get("bucket", "")
-        prefix = p.get("prefix", "")
-        dry = p.get("dryRun") == "true"
-        results = []
-        if bucket:
-            layer.healer.heal_bucket(bucket)
-            objs = ([o for o in layer.list_objects(
-                bucket, prefix=prefix, max_keys=100_000)])
-            for o in objs:
-                r = layer.healer.heal_object(bucket, o.name,
-                                             dry_run=dry)
-                results.append({
-                    "object": o.name, "beforeOk": r.before_ok,
+    @staticmethod
+    def _heal_sweep(layer, bucket: str, prefix: str, dry: bool):
+        """Yield one result dict per healed object — shared by the
+        synchronous handler and async sequences (ref healSequence's
+        traverseAndHeal)."""
+        def as_dict(r, name):
+            return {"object": name, "beforeOk": r.before_ok,
                     "afterOk": r.after_ok,
                     "healedDisks": r.healed_disks,
-                    "dangling": r.dangling})
+                    "dangling": r.dangling}
+        if bucket:
+            layer.healer.heal_bucket(bucket)
+            for o in layer.list_objects(bucket, prefix=prefix,
+                                        max_keys=1_000_000):
+                yield as_dict(layer.healer.heal_object(
+                    bucket, o.name, dry_run=dry), o.name)
         else:
             for r in layer.healer.heal_all():
-                results.append({
-                    "object": f"{r.bucket}/{r.object_name}",
-                    "beforeOk": r.before_ok, "afterOk": r.after_ok,
-                    "healedDisks": r.healed_disks,
-                    "dangling": r.dangling})
-        return {"items": results}
+                yield as_dict(r, f"{r.bucket}/{r.object_name}")
+
+    def h_heal(self, p, body):
+        return {"items": list(self._heal_sweep(
+            self.server.layer, p.get("bucket", ""), p.get("prefix", ""),
+            p.get("dryRun") == "true"))}
 
     # -- bucket quota (ref PutBucketQuotaConfigHandler,
     # cmd/admin-bucket-handlers.go) ------------------------------------
@@ -284,6 +283,64 @@ class AdminHandlers:
 
     def h_replication_stats(self, p, body):
         return dict(self._replication().stats)
+
+    # -- heal sequences (ref healSequence state machine,
+    # cmd/admin-heal-ops.go:353, allHealState:89) -----------------------
+
+    MAX_HEAL_SEQS = 16          # finished sequences kept around
+    MAX_SEQ_ITEMS = 10_000      # per-sequence result ring
+
+    def _prune_heal_seqs(self) -> None:
+        """Drop the oldest FINISHED sequences over the cap (the
+        reference purges after keepHealSeqStateDuration)."""
+        done = [(seq["finished"], tok) for tok, seq in
+                self._heal_seqs.items() if seq["status"] != "running"]
+        done.sort()
+        while len(self._heal_seqs) > self.MAX_HEAL_SEQS and done:
+            _, tok = done.pop(0)
+            self._heal_seqs.pop(tok, None)
+
+    def h_heal_start(self, p, body):
+        """Kick off an async heal sweep; poll with heal-status?token=.
+        The reference's POST /heal/... returns a clientToken the same
+        way (ref cmd/admin-heal-ops.go:353)."""
+        import threading
+        import uuid as _uuid
+        self._prune_heal_seqs()
+        token = _uuid.uuid4().hex[:12]
+        seq = {"status": "running", "items": [], "error": "",
+               "scanned": 0, "healed": 0,
+               "started": time.time(), "finished": 0.0}
+        self._heal_seqs[token] = seq
+        layer = self.server.layer
+        bucket, prefix = p.get("bucket", ""), p.get("prefix", "")
+        dry = p.get("dryRun") == "true"
+
+        def run():
+            try:
+                for item in self._heal_sweep(layer, bucket, prefix, dry):
+                    seq["scanned"] += 1
+                    if item["healedDisks"]:
+                        seq["healed"] += 1
+                    seq["items"].append(item)
+                    if len(seq["items"]) > self.MAX_SEQ_ITEMS:
+                        del seq["items"][:self.MAX_SEQ_ITEMS // 2]
+                seq["status"] = "done"
+            except Exception as e:  # noqa: BLE001
+                seq["status"] = "failed"
+                seq["error"] = str(e)
+            seq["finished"] = time.time()
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"heal-seq-{token}").start()
+        return {"clientToken": token}
+
+    def h_heal_status(self, p, body):
+        seq = self._heal_seqs[p["token"]]  # KeyError -> 404
+        return {"status": seq["status"], "error": seq["error"],
+                "itemsScanned": seq["scanned"],
+                "itemsHealed": seq["healed"],
+                "items": seq["items"][-1000:]}
 
     # -- locks ----------------------------------------------------------
 

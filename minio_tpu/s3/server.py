@@ -1431,6 +1431,65 @@ class S3ApiHandlers:
             req.bucket, req.key, {ol.META_LEGAL_HOLD: status}, version_id)
         return S3Response(200)
 
+    def post_policy_upload(self, req: S3Request, form,
+                           key: str) -> S3Response:
+        """Store a browser form upload through the SAME pipeline as a
+        PUT — bucket-default SSE, object-lock defaults, compression,
+        replication all apply (ref PostPolicyBucketHandler,
+        cmd/api-router.go:304; policy checks already done)."""
+        if not self.layer.bucket_exists(req.bucket):
+            raise s3err.ERR_NO_SUCH_BUCKET
+        if len(form.file_data) > MAX_OBJECT_SIZE:
+            raise s3err.ERR_ENTITY_TOO_LARGE
+        # Synthetic PUT view of the form: fields become headers so the
+        # shared lock/SSE/storage-class helpers read them uniformly.
+        sub = S3Request("PUT", req.raw_path, "", {
+            k.lower(): v for k, v in form.fields.items()},
+            form.file_data)
+        sub.bucket, sub.key = req.bucket, key
+        meta = {"content-type": form.file_content_type
+                or form.fields.get("Content-Type",
+                                   "application/octet-stream")}
+        for k, v in form.fields.items():
+            if k.lower().startswith("x-amz-meta-"):
+                meta[k.lower()] = v
+        self._apply_lock_headers(sub, meta)
+        parity = self._parity_for_request(sub)
+        self._check_quota(req.bucket, len(form.file_data))
+        body = self._maybe_compress(key, form.file_data, meta)
+        body = self._sse_encrypt_body(sub, body, meta)
+        self._replication_decision(sub, meta)
+        try:
+            info = self.layer.put_object(
+                req.bucket, key, body, metadata=meta,
+                versioned=self._versioned(req.bucket),
+                parity_shards=parity)
+        except ParentIsObject:
+            raise s3err.ERR_PARENT_IS_OBJECT
+        from ..event import event as ev
+        self._notify(ev.OBJECT_CREATED_POST, req.bucket, key, info)
+        self._queue_replication(sub, info, meta)
+        h = {"ETag": f'"{info.etag}"',
+             "Location": f"/{req.bucket}/{key}"}
+        h.update(self._sse_response_headers(info))
+        if info.version_id:
+            h["x-amz-version-id"] = info.version_id
+        redirect = form.fields.get("success_action_redirect", "")
+        if redirect:
+            sep = "&" if "?" in redirect else "?"
+            h["Location"] = (f"{redirect}{sep}" + urllib.parse.urlencode(
+                {"bucket": req.bucket, "key": key, "etag": info.etag}))
+            return S3Response(303, b"", h)
+        status = form.fields.get("success_action_status", "204")
+        if status == "201":
+            root = Element("PostResponse", S3_XMLNS)
+            root.child("Location", h["Location"])
+            root.child("Bucket", req.bucket)
+            root.child("Key", key)
+            root.child("ETag", h["ETag"])
+            return S3Response(201, root.tobytes(), h)
+        return S3Response(200 if status == "200" else 204, b"", h)
+
     def delete_object(self, req: S3Request) -> S3Response:
         version_id = self._version_param(req)
         self._check_version_delete_allowed(
@@ -1651,10 +1710,52 @@ class S3Server:
                                        ctx):
                 raise s3err.ERR_ACCESS_DENIED
 
+    def _post_policy(self, req: S3Request) -> S3Response:
+        """Auth + policy checks for a browser form POST, then store
+        (ref PostPolicyBucketHandler: the signature lives in the FORM,
+        not the headers)."""
+        from . import formupload as fu
+        try:
+            form = fu.parse_multipart(
+                req.headers.get("content-type", ""), req.body)
+        except fu.FormError:
+            raise s3err.ERR_MALFORMED_XML
+        if not form.has_file:
+            raise s3err.ERR_INVALID_ARGUMENT
+        policy_b64 = form.fields.get("policy", "")
+        if not policy_b64:
+            raise s3err.ERR_MISSING_AUTH
+        access_key = fu.verify_post_signature(policy_b64, form.fields,
+                                              self._lookup_secret)
+        try:
+            policy = fu.PostPolicy.from_json(
+                base64.b64decode(policy_b64))
+        except (fu.FormError, ValueError):
+            raise s3err.ERR_MALFORMED_POLICY
+        key = form.fields.get("key", "")
+        if not key:
+            raise s3err.ERR_INVALID_ARGUMENT
+        key = key.replace("${filename}", form.file_name)
+        fields = dict(form.fields)
+        fields["bucket"] = req.bucket
+        fields["key"] = key
+        try:
+            policy.check(fields, len(form.file_data))
+        except fu.PolicyViolation:
+            raise s3err.ERR_ACCESS_DENIED
+        if self.iam is not None and not self.iam.is_allowed(
+                access_key, "s3:PutObject", f"{req.bucket}/{key}", {}):
+            raise s3err.ERR_ACCESS_DENIED
+        return self.handlers.post_policy_upload(req, form, key)
+
     def route(self, req: S3Request) -> S3Response:
         h = self.handlers
         if h is None:
             raise s3err.ERR_SLOW_DOWN  # 503 until the layer is ready
+        if (req.method == "POST" and req.bucket and not req.key
+                and req.headers.get("content-type", "").startswith(
+                    "multipart/form-data")):
+            return self._post_policy(req)
         access_key = self.authenticate(req)
         m, bucket, key, p = req.method, req.bucket, req.key, req.params
         # STS API: POST / with Action=AssumeRole (ref cmd/sts-handlers.go).

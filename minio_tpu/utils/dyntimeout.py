@@ -1,0 +1,63 @@
+"""Self-tuning operation timeouts from success/failure history (ref
+cmd/dynamic-timeouts.go:35-101 — dynamicTimeout tracks the last N op
+durations; if too many hit the ceiling the timeout grows 25%, if the
+p75 runs far below it the timeout shrinks, never past a floor).
+"""
+
+from __future__ import annotations
+
+import threading
+
+LOG_SIZE = 64          # entries per adjustment window
+INCREASE_PCT = 0.33    # >33% timeouts in a window -> grow
+SHRINK_FACTOR = 0.75   # shrink step (ref dynamicTimeoutDecrease)
+GROW_FACTOR = 1.25     # grow step
+
+
+class DynamicTimeout:
+    """Thread-safe adaptive timeout in seconds."""
+
+    def __init__(self, timeout: float, minimum: float,
+                 maximum: float | None = None):
+        self._timeout = float(timeout)
+        self.minimum = float(minimum)
+        # Growth is geometric; without a ceiling repeated failures
+        # would inflate it unboundedly.
+        self.maximum = float(maximum) if maximum else float(timeout) * 8
+        self._mu = threading.Lock()
+        self._log: list[float] = []
+        self._failures = 0
+
+    @property
+    def timeout(self) -> float:
+        return self._timeout
+
+    def log_success(self, duration: float) -> None:
+        self._record(duration, failed=False)
+
+    def log_failure(self) -> None:
+        """An op hit the ceiling (timed out / peer unreachable)."""
+        self._record(self._timeout, failed=True)
+
+    def _record(self, duration: float, failed: bool) -> None:
+        with self._mu:
+            self._log.append(duration)
+            if failed:
+                self._failures += 1
+            if len(self._log) < LOG_SIZE:
+                return
+            # Window full: adjust once, reset.
+            fail_frac = self._failures / len(self._log)
+            if fail_frac > INCREASE_PCT:
+                self._timeout = min(self.maximum,
+                                    self._timeout * GROW_FACTOR)
+            else:
+                srt = sorted(self._log)
+                p75 = srt[(len(srt) * 3) // 4]
+                # Plenty of headroom -> tighten, but keep 2x the p75
+                # and never fall under the floor.
+                if p75 < self._timeout * SHRINK_FACTOR / 2:
+                    self._timeout = max(self.minimum, max(
+                        self._timeout * SHRINK_FACTOR, p75 * 2))
+            self._log.clear()
+            self._failures = 0

@@ -1,0 +1,228 @@
+"""POST policy form uploads, async heal sequences, dynamic timeouts
+(ref cmd/postpolicyform.go, cmd/admin-heal-ops.go,
+cmd/dynamic-timeouts.go)."""
+
+import base64
+import http.client
+import json
+import time
+
+import pytest
+
+from minio_tpu.erasure.engine import ErasureObjects
+from minio_tpu.s3 import formupload as fu
+from minio_tpu.s3.client import S3Client
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.xl import XLStorage
+from minio_tpu.utils.dyntimeout import LOG_SIZE, DynamicTimeout
+
+ACCESS, SECRET = "ppadmin", "ppadmin-secret"
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    root = tmp_path_factory.mktemp("ppdisks")
+    disks = [XLStorage(str(root / f"d{i}")) for i in range(4)]
+    layer = ErasureObjects(disks, block_size=64 * 1024)
+    srv = S3Server(layer, ACCESS, SECRET)
+    port = srv.start()
+    yield srv, port
+    srv.stop()
+
+
+@pytest.fixture
+def client(server):
+    _, port = server
+    return S3Client("127.0.0.1", port, ACCESS, SECRET)
+
+
+def _post_form(port, bucket, ctype, body):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request("POST", f"/{bucket}", body=body,
+                     headers={"Content-Type": ctype,
+                              "Content-Length": str(len(body))})
+        r = conn.getresponse()
+        return r.status, dict(r.getheaders()), r.read()
+    finally:
+        conn.close()
+
+
+def test_post_policy_upload(server, client):
+    _, port = server
+    client.make_bucket("formb")
+    ctype, body = fu.build_post_form(
+        "formb", "uploads/pic.bin", b"form-file-content", ACCESS, SECRET)
+    status, headers, out = _post_form(port, "formb", ctype, body)
+    assert status == 204, out
+    g = client.get_object("formb", "uploads/pic.bin")
+    assert g.status == 200 and g.body == b"form-file-content"
+
+
+def test_post_policy_success_action_201(server, client):
+    _, port = server
+    client.make_bucket("form201")
+    ctype, body = fu.build_post_form(
+        "form201", "a.txt", b"x", ACCESS, SECRET,
+        conditions=[["eq", "$success_action_status", "201"]],
+        extra_fields={"success_action_status": "201"})
+    status, _, out = _post_form(port, "form201", ctype, body)
+    assert status == 201
+    assert b"PostResponse" in out and b"a.txt" in out
+
+
+def test_post_policy_bad_signature(server, client):
+    _, port = server
+    client.make_bucket("formsig")
+    ctype, body = fu.build_post_form("formsig", "k", b"x", ACCESS,
+                                     "wrong-secret")
+    status, _, out = _post_form(port, "formsig", ctype, body)
+    assert status == 403
+
+
+def test_post_policy_condition_violation(server, client):
+    """Key outside the policy's starts-with prefix is refused."""
+    _, port = server
+    client.make_bucket("formcond")
+    # Policy pins key to exactly "allowed" but the form sends "other".
+    ctype, body = fu.build_post_form(
+        "formcond", "allowed", b"x", ACCESS, SECRET)
+    body = body.replace(
+        b'name="key"\r\n\r\nallowed', b'name="key"\r\n\r\nother')
+    status, _, out = _post_form(port, "formcond", ctype, body)
+    assert status == 403
+    assert not client.get_object("formcond", "other").status == 200
+
+
+def test_post_policy_expired(server, client):
+    _, port = server
+    client.make_bucket("formexp")
+    ctype, body = fu.build_post_form("formexp", "late", b"x", ACCESS,
+                                     SECRET, expires_in=-10)
+    status, _, _ = _post_form(port, "formexp", ctype, body)
+    assert status == 403
+
+
+def test_post_policy_content_length_range(server, client):
+    _, port = server
+    client.make_bucket("formrange")
+    ctype, body = fu.build_post_form(
+        "formrange", "big", b"Z" * 1000, ACCESS, SECRET,
+        conditions=[["content-length-range", 1, 100]])
+    status, _, _ = _post_form(port, "formrange", ctype, body)
+    assert status == 403
+
+
+def test_post_policy_filename_template(server, client):
+    _, port = server
+    client.make_bucket("formtpl")
+    ctype, body = fu.build_post_form(
+        "formtpl", "up/${filename}", b"tpl", ACCESS, SECRET,
+        conditions=None)
+    # build_post_form pins ["eq","$key","up/${filename}"]; the server
+    # substitutes the part filename BEFORE condition checks use the
+    # form's literal key, matching browser flows where the policy uses
+    # starts-with. Use a starts-with policy for the substituted form:
+    ctype, body = fu.build_post_form(
+        "formtpl", "up/${filename}", b"tpl", ACCESS, SECRET)
+    status, _, out = _post_form(port, "formtpl", ctype, body)
+    assert status == 204, out
+    g = client.get_object("formtpl", "up/upload")  # filename="upload"
+    assert g.status == 200 and g.body == b"tpl"
+
+
+# ---------------------------------------------------------------------------
+# heal sequences
+# ---------------------------------------------------------------------------
+
+
+def test_heal_sequence_roundtrip(server, client):
+    srv, _ = server
+    client.make_bucket("healseq")
+    for i in range(5):
+        client.put_object("healseq", f"o{i}", bytes([i]) * 2000)
+    # Corrupt: drop one disk's shard of o1.
+    import os
+    import shutil
+    d0 = srv.layer.disks[0]
+    shutil.rmtree(os.path.join(d0.root, "healseq", "o1"),
+                  ignore_errors=True)
+
+    r = client.request("POST", "/minio-tpu/admin/v1/heal-start",
+                       query="bucket=healseq")
+    assert r.status == 200, r.body
+    token = json.loads(r.body)["clientToken"]
+
+    deadline = time.time() + 20
+    doc = {}
+    while time.time() < deadline:
+        r = client.request("GET", "/minio-tpu/admin/v1/heal-status",
+                           query=f"token={token}")
+        doc = json.loads(r.body)
+        if doc["status"] in ("done", "failed"):
+            break
+        time.sleep(0.1)
+    assert doc["status"] == "done", doc
+    assert doc["itemsScanned"] == 5
+    assert doc["itemsHealed"] >= 1
+    # The shard is back on disk 0.
+    assert any(i["object"] == "o1" and i["healedDisks"]
+               for i in doc["items"])
+
+    r = client.request("GET", "/minio-tpu/admin/v1/heal-status",
+                       query="token=nonexistent")
+    assert r.status == 404
+
+
+# ---------------------------------------------------------------------------
+# dynamic timeouts
+# ---------------------------------------------------------------------------
+
+
+def test_dynamic_timeout_grows_on_failures():
+    dt = DynamicTimeout(10.0, minimum=1.0)
+    for _ in range(LOG_SIZE):
+        dt.log_failure()
+    assert dt.timeout > 10.0
+
+
+def test_dynamic_timeout_shrinks_when_fast():
+    dt = DynamicTimeout(10.0, minimum=1.0)
+    for _ in range(LOG_SIZE):
+        dt.log_success(0.01)
+    assert dt.timeout < 10.0
+    # Never under the floor, no matter how many windows.
+    for _ in range(LOG_SIZE * 20):
+        dt.log_success(0.0001)
+    assert dt.timeout >= 1.0
+
+
+def test_dynamic_timeout_stable_mixed():
+    dt = DynamicTimeout(10.0, minimum=1.0)
+    # Moderate durations, few failures: no big swings.
+    for _ in range(LOG_SIZE):
+        dt.log_success(4.0)
+    assert 7.0 <= dt.timeout <= 10.0
+
+
+def test_post_policy_uncovered_field_rejected(server, client):
+    """A signed form must not accept injected fields the policy never
+    constrained (the checkPostPolicy coverage rule)."""
+    _, port = server
+    client.make_bucket("formcover")
+    ctype, body = fu.build_post_form("formcover", "c.txt", b"x",
+                                     ACCESS, SECRET)
+    # Inject an extra metadata field not covered by any condition.
+    extra = (b'------minio-tpu-form-boundary\r\n'
+             b'Content-Disposition: form-data; '
+             b'name="x-amz-meta-evil"\r\n\r\ninjected\r\n')
+    body = body.replace(b"------minio-tpu-form-boundary\r\n",
+                        extra + b"------minio-tpu-form-boundary\r\n", 1)
+    status, _, out = _post_form(port, "formcover", ctype, body)
+    assert status == 403
+
+
+def test_post_policy_no_expiration_rejected():
+    with pytest.raises(fu.FormError):
+        fu.PostPolicy.from_json(
+            json.dumps({"conditions": [["eq", "$key", "k"]]}).encode())
