@@ -138,16 +138,20 @@ def _serve(args) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 1
 
+    from .logger import Logger
+    log = Logger.get()
     if hasattr(layer, "pools"):
         n_disks = sum(len(s.disks) for p in layer.pools for s in p.sets)
         eng = layer.pools[0].sets[0]
-        print(f"minio-tpu server: {len(layer.pools)} pool(s), "
-              f"{sum(len(p.sets) for p in layer.pools)} set(s), "
-              f"{n_disks} disks, EC {eng.k}+{eng.m}, "
-              f"listening on {host}:{port}")
+        msg = (f"minio-tpu server: {len(layer.pools)} pool(s), "
+               f"{sum(len(p.sets) for p in layer.pools)} set(s), "
+               f"{n_disks} disks, EC {eng.k}+{eng.m}, "
+               f"listening on {host}:{port}")
     else:
-        print(f"minio-tpu server: FS backend at {layer.root}, "
-              f"listening on {host}:{port}")
+        msg = (f"minio-tpu server: FS backend at {layer.root}, "
+               f"listening on {host}:{port}")
+    log.info(msg)
+    print(msg)
     print(f"   access key: {access}")
     sys.stdout.flush()
 

@@ -1,0 +1,3 @@
+"""Structured logging, console ring, audit webhook (ref cmd/logger/)."""
+
+from .logger import ConsoleLogRing, LogEntry, Logger  # noqa: F401

@@ -1,0 +1,95 @@
+"""Structured logger with console ring buffer (ref cmd/logger/logger.go,
+cmd/consolelogger.go — the ring feeds `mc admin console`).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from collections import deque
+from dataclasses import asdict, dataclass, field
+
+
+@dataclass
+class LogEntry:
+    level: str = "INFO"
+    time: float = 0.0
+    message: str = ""
+    source: str = ""
+    trace: list = field(default_factory=list)
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self))
+
+
+class ConsoleLogRing:
+    """Last-N log entries, served to `admin console-log` (ref
+    cmd/consolelogger.go HTTPConsoleLoggerSys ring)."""
+
+    def __init__(self, size: int = 10_000):
+        self._mu = threading.Lock()
+        self._ring: deque[LogEntry] = deque(maxlen=size)
+
+    def add(self, entry: LogEntry) -> None:
+        with self._mu:
+            self._ring.append(entry)
+
+    def tail(self, n: int = 100) -> list[LogEntry]:
+        if n <= 0:
+            return []
+        with self._mu:
+            items = list(self._ring)
+        return items[-n:]
+
+
+class Logger:
+    """Process-wide logger: console stderr + ring; one-time dedup of
+    repeated messages (ref cmd/logger/logonce.go)."""
+
+    _instance = None
+    _instance_mu = threading.Lock()
+
+    def __init__(self, json_output: bool = False):
+        self.ring = ConsoleLogRing()
+        self.json_output = json_output
+        self._once_seen: set[str] = set()
+        self._mu = threading.Lock()
+
+    @classmethod
+    def get(cls) -> "Logger":
+        with cls._instance_mu:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def _emit(self, level: str, message: str, source: str = "") -> None:
+        entry = LogEntry(level=level, time=time.time(), message=message,
+                         source=source)
+        self.ring.add(entry)
+        if self.json_output:
+            print(entry.to_json(), file=sys.stderr)
+        else:
+            ts = time.strftime("%H:%M:%S", time.localtime(entry.time))
+            print(f"{ts} {level:<5} {message}", file=sys.stderr)
+
+    def info(self, message: str, source: str = "") -> None:
+        self._emit("INFO", message, source)
+
+    def error(self, message: str, source: str = "") -> None:
+        self._emit("ERROR", message, source)
+
+    def warn(self, message: str, source: str = "") -> None:
+        self._emit("WARN", message, source)
+
+    def log_once(self, message: str, source: str = "") -> None:
+        """Errors that would repeat per-request are logged once (ref
+        logger.LogOnceIf)."""
+        with self._mu:
+            if message in self._once_seen:
+                return
+            if len(self._once_seen) > 4096:
+                self._once_seen.clear()
+            self._once_seen.add(message)
+        self._emit("ERROR", message, source)

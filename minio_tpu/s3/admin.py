@@ -342,6 +342,46 @@ class AdminHandlers:
                 "itemsHealed": seq["healed"],
                 "items": seq["items"][-1000:]}
 
+    # -- trace / console log (ref admin /trace streaming,
+    # cmd/admin-router.go:199; console cmd/consolelogger.go) -----------
+
+    def h_trace(self, p, body):
+        """Long-poll: subscribe to the request-trace hub and collect
+        entries for up to `timeout` seconds (default 3, cap 30). The
+        reference streams indefinitely over chunked HTTP; a bounded
+        collect keeps the admin API request/response."""
+        import queue as _queue
+        timeout = min(float(p.get("timeout", "3") or 3), 30.0)
+        hub = self.server.trace_hub
+        q = hub.subscribe()
+        entries = []
+        deadline = time.time() + timeout
+        try:
+            while time.time() < deadline and len(entries) < 10_000:
+                try:
+                    entries.append(q.get(
+                        timeout=max(0.01, deadline - time.time())))
+                except _queue.Empty:
+                    break
+        finally:
+            hub.unsubscribe(q)
+        return {"entries": entries}
+
+    def h_console_log(self, p, body):
+        from ..logger import Logger
+        n = min(int(p.get("n", "100") or 100), 10_000)
+        return {"entries": [
+            {"level": e.level, "time": e.time, "message": e.message,
+             "source": e.source} for e in Logger.get().ring.tail(n)]}
+
+    def h_audit_status(self, p, body):
+        a = self.server.audit
+        if a is None:
+            return {"configured": False}
+        return {"configured": True, "endpoint": a.endpoint,
+                "sent": a.sent, "failed": a.failed,
+                "dropped": a.dropped}
+
     # -- locks ----------------------------------------------------------
 
     def h_top_locks(self, p, body):

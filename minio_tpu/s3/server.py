@@ -1544,6 +1544,12 @@ class S3Server:
         from .admin import AdminHandlers, Metrics
         self.metrics = Metrics()
         self.admin = AdminHandlers(self)
+        from ..logger.audit import AuditWebhook
+        from ..utils.pubsub import PubSub
+        # Every request publishes a trace.Info analog here; admin
+        # /trace subscribes (ref globalHTTPTrace, cmd/globals.go:184).
+        self.trace_hub = PubSub()
+        self.audit = AuditWebhook.from_env()
         self.crawler = None  # attached by serve when scanning is on
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
@@ -1757,6 +1763,7 @@ class S3Server:
                     "multipart/form-data")):
             return self._post_policy(req)
         access_key = self.authenticate(req)
+        req.access_key = access_key  # audit/trace attribution
         m, bucket, key, p = req.method, req.bucket, req.key, req.params
         # STS API: POST / with Action=AssumeRole (ref cmd/sts-handlers.go).
         if not bucket and m == "POST":
@@ -1866,6 +1873,28 @@ class S3Server:
             return status, "application/json", out
         return 404, "text/plain", b"not found"
 
+    def publish_trace(self, api: str, method: str, path: str,
+                      status: int, duration_ms: float, rx: int, tx: int,
+                      request_id: str = "", remote: str = "",
+                      access_key: str = "") -> None:
+        """Fan a per-request trace entry to subscribers + the audit
+        sink (ref httpTraceAll wrapper, cmd/handler-utils.go:349, and
+        the AuditLog call in the same wrapper)."""
+        if self.trace_hub.subscriber_count:
+            self.trace_hub.publish({
+                "time": time.time(), "api": api, "method": method,
+                "path": path, "statusCode": status,
+                "durationMs": round(duration_ms, 3),
+                "rx": rx, "tx": tx, "requestID": request_id,
+                "remote": remote, "accessKey": access_key,
+            })
+        if self.audit is not None:
+            from ..logger.audit import audit_entry
+            self.audit.send(audit_entry(
+                api, method, path, status, duration_ms, rx, tx,
+                access_key=access_key, request_id=request_id,
+                remote=remote))
+
     def _cluster_healthy(self) -> bool:
         """Quorum-aware cluster check (ref ClusterCheckHandler,
         cmd/healthcheck-handler.go:30): every set must have >= read
@@ -1931,6 +1960,7 @@ class S3Server:
                 pass
 
             def _handle(self):
+                t0 = time.monotonic()
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     body = self.rfile.read(length) if length else b""
@@ -1974,6 +2004,10 @@ class S3Server:
                     except (QuorumError, Exception) as e:  # noqa: BLE001
                         if isinstance(e, APIError):
                             raise
+                        from ..logger import Logger
+                        Logger.get().log_once(
+                            f"{self.command} {raw_path}: "
+                            f"{type(e).__name__}: {e}", "s3-handler")
                         err = s3err.ERR_INTERNAL_ERROR
                         resp = S3Response(
                             err.http_status,
@@ -1983,6 +2017,12 @@ class S3Server:
                            f"{'object' if req.key else 'bucket' if req.bucket else 'service'}")
                     server.metrics.record(api, resp.status, len(body),
                                           len(resp.body))
+                    server.publish_trace(
+                        api, self.command, raw_path, resp.status,
+                        (time.monotonic() - t0) * 1000.0, len(body),
+                        len(resp.body), req.request_id,
+                        self.client_address[0],
+                        getattr(req, "access_key", ""))
                     self.send_response(resp.status)
                     self.send_header("x-amz-request-id", req.request_id)
                     self.send_header("Server", "MinIO-TPU")
@@ -2021,3 +2061,5 @@ class S3Server:
             self.notifier.close()
         if self.handlers is not None:
             self.handlers.replication.close()
+        if self.audit is not None:
+            self.audit.close()

@@ -1,0 +1,46 @@
+"""Tiny thread-safe pub/sub hub (ref pkg/pubsub/pubsub.go, 176 LoC —
+the fan-out behind `mc admin trace` and console-log streaming).
+
+Subscribers get a bounded Queue; slow subscribers drop messages rather
+than stall publishers (same non-blocking send as the reference's
+buffered-channel subscribers).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+
+class PubSub:
+    def __init__(self, buffer: int = 1000):
+        self._mu = threading.Lock()
+        self._subs: list[queue.Queue] = []
+        self.buffer = buffer
+
+    def publish(self, item) -> None:
+        with self._mu:
+            subs = list(self._subs)
+        for q in subs:
+            try:
+                q.put_nowait(item)
+            except queue.Full:
+                pass  # slow subscriber: drop, never block the data path
+
+    def subscribe(self) -> queue.Queue:
+        q: queue.Queue = queue.Queue(maxsize=self.buffer)
+        with self._mu:
+            self._subs.append(q)
+        return q
+
+    def unsubscribe(self, q: queue.Queue) -> None:
+        with self._mu:
+            try:
+                self._subs.remove(q)
+            except ValueError:
+                pass
+
+    @property
+    def subscriber_count(self) -> int:
+        with self._mu:
+            return len(self._subs)

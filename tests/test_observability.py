@@ -1,0 +1,142 @@
+"""Trace hub, console log ring, audit webhook (ref pkg/pubsub,
+cmd/handler-utils.go httpTraceAll, cmd/logger/audit.go,
+cmd/consolelogger.go)."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from minio_tpu.erasure.engine import ErasureObjects
+from minio_tpu.logger import Logger
+from minio_tpu.logger.audit import AuditWebhook, audit_entry
+from minio_tpu.s3.client import S3Client
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.xl import XLStorage
+from minio_tpu.utils.pubsub import PubSub
+
+ACCESS, SECRET = "obsadmin", "obsadmin-secret"
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    root = tmp_path_factory.mktemp("obsdisks")
+    disks = [XLStorage(str(root / f"d{i}")) for i in range(4)]
+    layer = ErasureObjects(disks, block_size=64 * 1024)
+    srv = S3Server(layer, ACCESS, SECRET)
+    port = srv.start()
+    yield srv, port
+    srv.stop()
+
+
+@pytest.fixture
+def client(server):
+    _, port = server
+    return S3Client("127.0.0.1", port, ACCESS, SECRET)
+
+
+def test_pubsub_fanout_and_drop():
+    hub = PubSub(buffer=4)
+    a, b = hub.subscribe(), hub.subscribe()
+    for i in range(10):
+        hub.publish(i)
+    # Bounded queues: only the first 4 survive per subscriber.
+    got_a = [a.get_nowait() for _ in range(a.qsize())]
+    got_b = [b.get_nowait() for _ in range(b.qsize())]
+    assert got_a == got_b == [0, 1, 2, 3]
+    hub.unsubscribe(a)
+    hub.publish(99)
+    assert a.qsize() == 0 and b.qsize() == 1
+
+
+def test_admin_trace_captures_requests(server, client):
+    """Subscribe via admin trace, fire S3 traffic from another thread,
+    see the entries."""
+    client.make_bucket("traceb")
+
+    def later():
+        time.sleep(0.3)
+        client.put_object("traceb", "t.txt", b"traced")
+        client.get_object("traceb", "t.txt")
+
+    t = threading.Thread(target=later)
+    t.start()
+    r = client.request("GET", "/minio-tpu/admin/v1/trace",
+                       query="timeout=2")
+    t.join()
+    assert r.status == 200
+    entries = json.loads(r.body)["entries"]
+    apis = [(e["method"], e["api"]) for e in entries]
+    assert ("PUT", "PUT-object") in apis
+    assert ("GET", "GET-object") in apis
+    e = next(e for e in entries if e["api"] == "PUT-object")
+    assert e["path"] == "/traceb/t.txt"
+    assert e["statusCode"] == 200
+    assert e["rx"] == 6 and e["durationMs"] > 0
+
+
+def test_trace_not_published_without_subscribers(server, client):
+    srv, _ = server
+    assert srv.trace_hub.subscriber_count == 0
+    client.make_bucket("notrace")  # must not error / leak
+
+
+def test_console_log_ring(server, client):
+    log = Logger.get()
+    log.info("observability test message")
+    log.log_once("dup-error")
+    log.log_once("dup-error")  # deduped
+    r = client.request("GET", "/minio-tpu/admin/v1/console-log",
+                       query="n=50")
+    entries = json.loads(r.body)["entries"]
+    msgs = [e["message"] for e in entries]
+    assert "observability test message" in msgs
+    assert msgs.count("dup-error") == 1
+
+
+def test_audit_webhook_delivery(server, client):
+    """Point the audit sink at a local HTTP server, fire a request,
+    expect an entry with the reference's field shape."""
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+    got = []
+
+    class Sink(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            got.append(json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    sink = HTTPServer(("127.0.0.1", 0), Sink)
+    threading.Thread(target=sink.serve_forever, daemon=True).start()
+    srv, _ = server
+    srv.audit = AuditWebhook(
+        f"http://127.0.0.1:{sink.server_address[1]}/audit")
+    try:
+        client.make_bucket("auditb")
+        client.put_object("auditb", "a.txt", b"x")
+        deadline = time.time() + 5
+        while time.time() < deadline and not any(
+                e["api"]["name"] == "PUT-object" for e in got):
+            time.sleep(0.05)
+        entry = next(e for e in got if e["api"]["name"] == "PUT-object")
+        assert entry["api"]["method"] == "PUT"
+        assert entry["api"]["path"] == "/auditb/a.txt"
+        assert entry["api"]["statusCode"] == 200
+        assert entry["version"] == "1"
+        assert entry["requestID"]
+    finally:
+        srv.audit.close()
+        srv.audit = None
+        sink.shutdown()
+
+
+def test_audit_entry_shape():
+    e = audit_entry("GET-object", "GET", "/b/k", 200, 12.5, 0, 100,
+                    request_id="RID")
+    assert e["api"]["timeToResponseNs"] == 12_500_000
+    assert e["api"]["rx"] == 0 and e["api"]["tx"] == 100
