@@ -342,6 +342,33 @@ class AdminHandlers:
                 "itemsHealed": seq["healed"],
                 "items": seq["items"][-1000:]}
 
+    # -- config KV (ref admin config APIs, cmd/admin-handlers-config-kv.go)
+
+    def _config(self):
+        if self.server.config is None:
+            raise ValueError("config system not ready")
+        return self.server.config
+
+    def h_get_config(self, p, body):
+        return {"config": self._config().dump()}
+
+    def h_set_config_kv(self, p, body):
+        # Unknown names / rejected values raise ValueError subclasses,
+        # which handle() maps to 400.
+        self._config().set_kv(body.decode("utf-8"))
+        return {"ok": True, "restart": False}
+
+    def h_del_config_kv(self, p, body):
+        self._config().del_kv(body.decode("utf-8").strip())
+        return {"ok": True}
+
+    def h_config_history(self, p, body):
+        return {"entries": self._config().history_ids()}
+
+    def h_restore_config(self, p, body):
+        self._config().restore(p["id"])
+        return {"ok": True}
+
     # -- trace / console log (ref admin /trace streaming,
     # cmd/admin-router.go:199; console cmd/consolelogger.go) -----------
 

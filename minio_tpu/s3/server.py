@@ -1539,6 +1539,9 @@ class S3Server:
         self.iam = iam  # IAMSys; None = root-credentials-only mode
         self.handlers = None
         self.bucket_meta = None
+        self.config = None  # ConfigSys once the layer attaches
+        self.audit = None
+        self._audit_from_env = False
         if layer is not None:
             self.set_layer(layer)
         from .admin import AdminHandlers, Metrics
@@ -1549,7 +1552,9 @@ class S3Server:
         # Every request publishes a trace.Info analog here; admin
         # /trace subscribes (ref globalHTTPTrace, cmd/globals.go:184).
         self.trace_hub = PubSub()
-        self.audit = AuditWebhook.from_env()
+        if self.audit is None:
+            self.audit = AuditWebhook.from_env()
+            self._audit_from_env = self.audit is not None
         self.crawler = None  # attached by serve when scanning is on
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
@@ -1565,6 +1570,74 @@ class S3Server:
         from ..bucket.metadata import BucketMetadataSys
         self.bucket_meta = BucketMetadataSys.for_layer(layer)
         self.handlers = S3ApiHandlers(layer, self.region, self.bucket_meta)
+        from ..config.kv import ConfigSys
+        self.config = ConfigSys(self.bucket_meta.store)
+        self.config.validators.append(self._validate_config)
+        self.config.on_change(self._apply_config)
+        self._apply_config(self.config)
+
+    def _validate_config(self, subsys: str, target: str,
+                         kvs: dict) -> None:
+        """Reject values that would break the running system BEFORE
+        they persist (ref per-subsystem validation in lookupConfigs)."""
+        if subsys == "storage_class":
+            from ..config.storageclass import _parse_ec
+            n = getattr(self.layer, "k", 0) + getattr(self.layer, "m", 0)
+            for key, v in kvs.items():
+                try:
+                    m = _parse_ec(v)
+                except Exception as e:
+                    raise ValueError(f"storage_class {key}: {e}")
+                if m is not None and n >= 2 and not (0 < m <= n // 2):
+                    raise ValueError(
+                        f"storage_class {key}={v}: parity out of range "
+                        f"for {n}-disk sets")
+        if subsys == "audit_webhook":
+            ep = kvs.get("endpoint")
+            if ep:
+                from urllib.parse import urlparse
+                if urlparse(ep).scheme not in ("http", "https"):
+                    raise ValueError(f"audit endpoint {ep!r} must be "
+                                     "http(s)")
+
+    def _apply_config(self, cfg) -> None:
+        """Push dynamic config into the running subsystems (the
+        reference's dynamic-subsystem reload on SetKVS)."""
+        from ..config.storageclass import StorageClassConfig, _parse_ec
+        from ..logger.audit import AuditWebhook
+        h = self.handlers
+        if h is None:
+            return
+        # compression.enable flips the PUT-path wrap live; env keeps
+        # its historical override.
+        import os as _os
+        h.compress_enabled = (
+            _os.environ.get("MINIO_COMPRESS", "") == "on"
+            or cfg.get("compression", "enable") == "on")
+        try:
+            h.storage_class = StorageClassConfig(
+                standard_parity=_parse_ec(
+                    cfg.get("storage_class", "standard")),
+                rrs_parity=_parse_ec(cfg.get("storage_class", "rrs")))
+        except Exception as e:  # env override may carry garbage
+            from ..logger import Logger
+            Logger.get().log_once(
+                f"storage_class config invalid, keeping previous: {e}",
+                "config")
+        ep = cfg.get("audit_webhook", "endpoint")
+        tok = cfg.get("audit_webhook", "auth_token")
+        if cfg.get("audit_webhook", "enable") == "on" and ep:
+            if (self.audit is None or self.audit.endpoint != ep
+                    or self.audit.auth_token != tok):
+                if self.audit is not None:
+                    self.audit.close()
+                self.audit = AuditWebhook(ep, tok)
+                self._audit_from_env = False
+        elif self.audit is not None and not self._audit_from_env:
+            # Config turned it off: stop posting. An env-configured
+            # sink survives config (env always wins).
+            self.audit.close()
+            self.audit = None
 
     def _lookup_secret(self, access_key: str) -> str | None:
         if self.iam is not None:
@@ -2039,7 +2112,15 @@ class S3Server:
 
             do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _handle
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        class _Server(ThreadingHTTPServer):
+            # Keep-alive handler threads must never block shutdown
+            # (the reference's xhttp.Server drains with a deadline,
+            # cmd/http/server.go:117).
+            daemon_threads = True
+            block_on_close = False
+
+        Handler.timeout = 120  # idle keep-alive reaper
+        self._httpd = _Server((host, port), Handler)
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
