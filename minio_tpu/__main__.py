@@ -99,6 +99,14 @@ def _make_iam(layer, access: str, secret: str):
     return IAMSys(ConfigStore(disks), access, secret)
 
 
+def _maybe_wrap_cache(layer):
+    """Optional SSD edge cache in front of any backend topology (ref
+    newServerCacheObjects gate, cmd/server-main.go:517)."""
+    from .cache import CacheConfig, CacheObjectLayer
+    cfg = CacheConfig.from_env()
+    return layer if cfg is None else CacheObjectLayer(layer, cfg)
+
+
 def _serve(args) -> int:
     from .s3.server import S3Server
 
@@ -126,11 +134,12 @@ def _serve(args) -> int:
             node = build_cluster_node(args.disks, my_host, port,
                                       access, secret, args.block_size,
                                       registry=boot_registry)
-            server.set_layer(node.layer)
+            layer = _maybe_wrap_cache(node.layer)
+            server.set_layer(layer)
             server.iam = _make_iam(node.layer, access, secret)
-            layer = node.layer
         else:
-            layer = build_object_layer(args.disks, args.block_size)
+            layer = _maybe_wrap_cache(
+                build_object_layer(args.disks, args.block_size))
             server = S3Server(layer, access, secret,
                               iam=_make_iam(layer, access, secret))
             port = server.start(host, port)

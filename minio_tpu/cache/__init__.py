@@ -1,0 +1,3 @@
+"""SSD edge-cache ObjectLayer wrapper (ref cmd/disk-cache.go)."""
+
+from .diskcache import CacheConfig, CacheObjectLayer  # noqa: F401

@@ -1,0 +1,329 @@
+"""Disk cache: an ObjectLayer wrapper that serves hot reads from local
+cache drives (ref cacheObjects, cmd/disk-cache.go:88,
+newServerCacheObjects:748; per-drive backend cmd/disk-cache-backend.go).
+
+Semantics mirrored from the reference:
+  - object -> cache drive by consistent hash of the key
+  - GET validates against the backend's ETag; hit = serve local bytes,
+    miss = read backend and populate (async in the reference; inline
+    here, it's one local file write)
+  - backend unreachable -> serve the cached copy (edge mode)
+  - PUT/DELETE write through to the backend and invalidate the entry
+  - watermark GC: past `high_watermark`% usage evict by LRU atime
+    until under `low_watermark`%
+  - only objects <= max_object_size are cached; ranges are sliced out
+    of the cached full object
+Layout per drive: `<drive>/<sha(bucket/key)>/cache.json` + `data`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+
+from ..erasure.engine import (BucketNotFound, MethodNotAllowed,
+                              ObjectInfo, ObjectNotFound)
+
+
+@dataclass
+class CacheConfig:
+    drives: list[str] | None = None
+    max_object_size: int = 128 * 1024 * 1024
+    quota_bytes: int = 0          # 0 = whole drive
+    high_watermark: int = 90      # % of quota
+    low_watermark: int = 70
+
+    @classmethod
+    def from_env(cls, env=os.environ) -> "CacheConfig | None":
+        drives = env.get("MINIO_CACHE_DRIVES", "")
+        if not drives:
+            return None
+        return cls(
+            drives=[d for d in drives.split(",") if d],
+            quota_bytes=int(env.get("MINIO_CACHE_QUOTA_BYTES", "0")),
+            high_watermark=int(env.get("MINIO_CACHE_WATERMARK_HIGH",
+                                       "90")),
+            low_watermark=int(env.get("MINIO_CACHE_WATERMARK_LOW",
+                                      "70")),
+        )
+
+
+class _CacheDrive:
+    """One cache directory: entry store + LRU GC (ref diskCache,
+    cmd/disk-cache-backend.go)."""
+
+    def __init__(self, root: str, quota_bytes: int, hi: int, lo: int):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        if not quota_bytes:
+            # "whole drive": cap at the filesystem's capacity so GC
+            # still runs before the drive wedges at 100%.
+            import shutil as _shutil
+            quota_bytes = _shutil.disk_usage(self.root).total
+        self.quota = quota_bytes
+        self.hi, self.lo = hi, lo
+        self._mu = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        # Running usage counter: a full tree walk only happens once at
+        # startup, not on every populate.
+        self._used = self.usage_bytes()
+
+    def _entry_dir(self, bucket: str, key: str) -> str:
+        h = hashlib.sha256(f"{bucket}/{key}".encode()).hexdigest()
+        return os.path.join(self.root, h[:2], h)
+
+    def get(self, bucket: str, key: str) -> tuple[dict, bytes] | None:
+        d = self._entry_dir(bucket, key)
+        try:
+            with open(os.path.join(d, "cache.json")) as f:
+                meta = json.load(f)
+            with open(os.path.join(d, "data"), "rb") as f:
+                data = f.read()
+        except (OSError, ValueError):
+            return None
+        if len(data) != meta.get("size", -1):
+            return None  # torn write
+        # LRU bump (atime may be disabled by the fs mount).
+        try:
+            os.utime(os.path.join(d, "cache.json"))
+        except OSError:
+            pass
+        return meta, data
+
+    def put(self, bucket: str, key: str, info: ObjectInfo,
+            data: bytes) -> None:
+        d = self._entry_dir(bucket, key)
+        os.makedirs(d, exist_ok=True)
+        tmp = os.path.join(d, f".tmp-{uuid.uuid4().hex[:8]}")
+        meta = {"bucket": bucket, "key": key, "etag": info.etag,
+                "size": len(data), "mod_time": info.mod_time,
+                "metadata": dict(info.metadata),
+                "cached_at": time.time()}
+        try:
+            old_sz = self._entry_size(d)
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, os.path.join(d, "data"))
+            with open(tmp, "w") as f:
+                json.dump(meta, f)
+            os.replace(tmp, os.path.join(d, "cache.json"))
+            with self._mu:
+                self._used += self._entry_size(d) - old_sz
+        except OSError:
+            return  # cache is best-effort; never fail the read
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        self.maybe_gc()
+
+    @staticmethod
+    def _entry_size(d: str) -> int:
+        total = 0
+        for fn in ("cache.json", "data"):
+            try:
+                total += os.path.getsize(os.path.join(d, fn))
+            except OSError:
+                pass
+        return total
+
+    def delete(self, bucket: str, key: str) -> None:
+        d = self._entry_dir(bucket, key)
+        freed = self._entry_size(d)
+        for fn in ("cache.json", "data"):
+            try:
+                os.remove(os.path.join(d, fn))
+            except OSError:
+                pass
+        with self._mu:
+            self._used = max(0, self._used - freed)
+
+    # -- GC -------------------------------------------------------------
+
+    def usage_bytes(self) -> int:
+        total = 0
+        for dirpath, _dirs, files in os.walk(self.root):
+            for fn in files:
+                try:
+                    total += os.path.getsize(os.path.join(dirpath, fn))
+                except OSError:
+                    pass
+        return total
+
+    def maybe_gc(self) -> None:
+        """Evict LRU entries once past the high watermark until under
+        the low one (ref diskCache.purge watermark loop)."""
+        with self._mu:
+            used = self._used
+            if used * 100 < self.quota * self.hi:
+                return
+            entries = []  # (mtime, dir, bytes)
+            for sub in os.listdir(self.root):
+                subp = os.path.join(self.root, sub)
+                if not os.path.isdir(subp):
+                    continue
+                for ent in os.listdir(subp):
+                    d = os.path.join(subp, ent)
+                    cj = os.path.join(d, "cache.json")
+                    try:
+                        sz = (os.path.getsize(cj) + os.path.getsize(
+                            os.path.join(d, "data")))
+                        entries.append((os.path.getmtime(cj), d, sz))
+                    except OSError:
+                        continue
+            entries.sort()  # oldest first
+            for _, d, sz in entries:
+                if used * 100 <= self.quota * self.lo:
+                    break
+                for fn in ("cache.json", "data"):
+                    try:
+                        os.remove(os.path.join(d, fn))
+                    except OSError:
+                        pass
+                used -= sz
+            self._used = max(0, used)
+
+
+class CacheObjectLayer:
+    """ObjectLayer wrapper: reads fall back through the cache; writes
+    pass through and invalidate (ref cacheObjects GetObjectNInfo /
+    PutObject flow, cmd/disk-cache.go)."""
+
+    def __init__(self, backend, config: CacheConfig):
+        self.backend = backend
+        self.config = config
+        self.drives = [
+            _CacheDrive(d, config.quota_bytes, config.high_watermark,
+                        config.low_watermark)
+            for d in (config.drives or [])]
+        if not self.drives:
+            raise ValueError("disk cache needs at least one drive")
+
+    # Everything not overridden goes straight to the backend —
+    # multipart, healer, listings, bucket ops, metadata updates.
+    def __getattr__(self, name):
+        return getattr(self.backend, name)
+
+    def _drive(self, bucket: str, key: str) -> _CacheDrive:
+        h = int.from_bytes(hashlib.sha256(
+            f"{bucket}/{key}".encode()).digest()[:4], "big")
+        return self.drives[h % len(self.drives)]
+
+    # -- reads ----------------------------------------------------------
+
+    def get_object(self, bucket: str, object_name: str, offset: int = 0,
+                   length: int = -1, version_id: str = "",
+                   ) -> tuple[bytes, ObjectInfo]:
+        if version_id:  # versioned reads bypass the cache (latest-only)
+            return self.backend.get_object(bucket, object_name,
+                                           offset=offset, length=length,
+                                           version_id=version_id)
+        drive = self._drive(bucket, object_name)
+        cached = drive.get(bucket, object_name)
+        try:
+            info = self.backend.get_object_info(bucket, object_name)
+        except (ObjectNotFound, BucketNotFound, MethodNotAllowed):
+            # Semantic answers (404s) must propagate — a stale cached
+            # copy of a deleted object is not "edge mode".
+            drive.delete(bucket, object_name)
+            raise
+        except Exception:
+            # Backend down: serve the edge copy if we hold one (ref
+            # the cache-on-offline path in cacheObjects.GetObjectNInfo).
+            if cached is not None:
+                drive.hits += 1
+                meta, data = cached
+                return self._slice(data, offset, length), \
+                    self._info_from_cache(meta)
+            raise
+        if cached is not None and cached[0]["etag"] == info.etag:
+            drive.hits += 1
+            return self._slice(cached[1], offset, length), info
+        if info.size > self.config.max_object_size:
+            # Never cacheable: stream just the requested range.
+            return self.backend.get_object(bucket, object_name,
+                                           offset=offset, length=length)
+        drive.misses += 1
+        data, info = self.backend.get_object(bucket, object_name)
+        drive.put(bucket, object_name, info, data)
+        return self._slice(data, offset, length), info
+
+    def get_object_info(self, bucket: str, object_name: str,
+                        version_id: str = "") -> ObjectInfo:
+        """HEAD falls back to the cached copy when the backend is
+        unreachable — the S3 GET handler stats before reading, so edge
+        mode must cover this path too."""
+        if version_id:
+            return self.backend.get_object_info(bucket, object_name,
+                                                version_id)
+        try:
+            return self.backend.get_object_info(bucket, object_name)
+        except (ObjectNotFound, BucketNotFound, MethodNotAllowed):
+            raise
+        except Exception:
+            cached = self._drive(bucket, object_name).get(bucket,
+                                                          object_name)
+            if cached is not None:
+                return self._info_from_cache(cached[0])
+            raise
+
+    @staticmethod
+    def _slice(data: bytes, offset: int, length: int) -> bytes:
+        if offset == 0 and length < 0:
+            return data
+        if length < 0:
+            return data[offset:]
+        return data[offset:offset + length]
+
+    @staticmethod
+    def _info_from_cache(meta: dict) -> ObjectInfo:
+        return ObjectInfo(bucket=meta["bucket"], name=meta["key"],
+                          size=meta["size"], etag=meta["etag"],
+                          mod_time=meta["mod_time"],
+                          metadata=dict(meta["metadata"]))
+
+    # -- writes (through + invalidate) ----------------------------------
+
+    def put_object(self, bucket: str, object_name: str, data: bytes,
+                   **kw) -> ObjectInfo:
+        info = self.backend.put_object(bucket, object_name, data, **kw)
+        self._drive(bucket, object_name).delete(bucket, object_name)
+        return info
+
+    def delete_object(self, bucket: str, object_name: str,
+                      version_id: str = "",
+                      versioned: bool = False) -> ObjectInfo:
+        out = self.backend.delete_object(bucket, object_name,
+                                         version_id,
+                                         versioned=versioned)
+        self._drive(bucket, object_name).delete(bucket, object_name)
+        return out
+
+    def update_object_metadata(self, bucket: str, object_name: str,
+                               updates: dict,
+                               version_id: str = "") -> None:
+        self.backend.update_object_metadata(bucket, object_name,
+                                            updates, version_id)
+        # Metadata lives in the cached entry too: drop it.
+        self._drive(bucket, object_name).delete(bucket, object_name)
+
+    def put_object_tags(self, bucket: str, object_name: str, tags: str,
+                        version_id: str = "") -> None:
+        self.backend.put_object_tags(bucket, object_name, tags,
+                                     version_id)
+        self._drive(bucket, object_name).delete(bucket, object_name)
+
+    # -- stats ----------------------------------------------------------
+
+    def cache_stats(self) -> dict:
+        return {
+            "drives": [{
+                "root": d.root, "hits": d.hits, "misses": d.misses,
+                "usedBytes": d.usage_bytes(), "quotaBytes": d.quota,
+            } for d in self.drives],
+        }

@@ -342,6 +342,14 @@ class AdminHandlers:
                 "itemsHealed": seq["healed"],
                 "items": seq["items"][-1000:]}
 
+    # -- disk cache ----------------------------------------------------
+
+    def h_cache_stats(self, p, body):
+        layer = self.server.layer
+        if not hasattr(layer, "cache_stats"):
+            return {"enabled": False}
+        return {"enabled": True, **layer.cache_stats()}
+
     # -- config KV (ref admin config APIs, cmd/admin-handlers-config-kv.go)
 
     def _config(self):
