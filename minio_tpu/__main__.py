@@ -22,11 +22,93 @@ def main(argv: list[str] | None = None) -> int:
                      help="listen address (host:port)")
     srv.add_argument("--block-size", type=int, default=None,
                      help="erasure stripe block size in bytes")
+
+    gw = sub.add_parser("gateway",
+                        help="serve S3 over a foreign backend "
+                             "(ref cmd/gateway-main.go)")
+    gw.add_argument("backend", choices=["nas", "s3"])
+    gw.add_argument("target",
+                    help="nas: a directory; s3: http://host:port of "
+                         "the upstream store")
+    gw.add_argument("--address", default="0.0.0.0:9000")
+    gw.add_argument("--meta-dir", default="",
+                    help="s3 gateway: local dir for bucket metadata "
+                         "(default <target-hash> under ~/.minio-tpu)")
     args = parser.parse_args(argv)
 
     if args.command == "server":
         return _serve(args)
+    if args.command == "gateway":
+        return _serve_gateway(args)
     return 2
+
+
+def _parse_address(address: str) -> tuple[str, int]:
+    host, _, port_s = address.rpartition(":")
+    return host or "0.0.0.0", int(port_s)
+
+
+def _env_creds() -> tuple[str, str]:
+    return (os.environ.get("MINIO_ACCESS_KEY", "minioadmin"),
+            os.environ.get("MINIO_SECRET_KEY", "minioadmin"))
+
+
+def _announce(msg: str, access: str) -> None:
+    from .logger import Logger
+    Logger.get().info(msg)
+    print(msg)
+    print(f"   access key: {access}")
+    sys.stdout.flush()
+
+
+def _wait_for_sigterm() -> None:
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            signal.pause()
+    except KeyboardInterrupt:
+        pass
+
+
+def _serve_gateway(args) -> int:
+    """`minio-tpu gateway nas /mnt` / `gateway s3 http://host:port`
+    (ref gateway-main.go startup: build layer from Gateway, same
+    router)."""
+    import hashlib
+
+    from .s3.server import S3Server
+
+    host, port = _parse_address(args.address)
+    access, secret = _env_creds()
+
+    if args.backend == "nas":
+        from .gateway import NASGateway
+        os.makedirs(args.target, exist_ok=True)
+        layer = NASGateway(args.target).new_gateway_layer()
+    else:
+        from .bucket.replication import BucketTargetSys
+        from .gateway import S3Gateway
+        ep = BucketTargetSys.normalize_endpoint(args.target)
+        h, _, prt = ep.partition(":")
+        meta_dir = args.meta_dir or os.path.join(
+            os.path.expanduser("~/.minio-tpu"), "gateway",
+            hashlib.sha256(ep.encode()).hexdigest()[:12])
+        os.makedirs(meta_dir, exist_ok=True)
+        # Upstream credentials: same env pair (the reference reuses
+        # MINIO_ACCESS_KEY/SECRET_KEY for the backend account too).
+        layer = S3Gateway(h, int(prt), access, secret,
+                          meta_dir).new_gateway_layer()
+
+    layer = _maybe_wrap_cache(layer)
+    server = S3Server(layer, access, secret,
+                      iam=_make_iam(layer, access, secret))
+    port = server.start(host, port)
+    _announce(f"minio-tpu gateway [{args.backend}] -> {args.target}, "
+              f"listening on {host}:{port}", access)
+    _wait_for_sigterm()
+    server.stop()
+    return 0
 
 
 def build_object_layer(disk_args: list[str],
@@ -110,11 +192,8 @@ def _maybe_wrap_cache(layer):
 def _serve(args) -> int:
     from .s3.server import S3Server
 
-    host, _, port_s = args.address.rpartition(":")
-    host = host or "0.0.0.0"
-    port = int(port_s)
-    access = os.environ.get("MINIO_ACCESS_KEY", "minioadmin")
-    secret = os.environ.get("MINIO_SECRET_KEY", "minioadmin")
+    host, port = _parse_address(args.address)
+    access, secret = _env_creds()
 
     distributed = any(a.startswith(("http://", "https://"))
                       for a in args.disks)
@@ -147,8 +226,6 @@ def _serve(args) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 1
 
-    from .logger import Logger
-    log = Logger.get()
     if hasattr(layer, "pools"):
         n_disks = sum(len(s.disks) for p in layer.pools for s in p.sets)
         eng = layer.pools[0].sets[0]
@@ -159,10 +236,7 @@ def _serve(args) -> int:
     else:
         msg = (f"minio-tpu server: FS backend at {layer.root}, "
                f"listening on {host}:{port}")
-    log.info(msg)
-    print(msg)
-    print(f"   access key: {access}")
-    sys.stdout.flush()
+    _announce(msg, access)
 
     # Notification targets from env (ref config/notify webhook subsys:
     # MINIO_NOTIFY_WEBHOOK_ENABLE/ENDPOINT/QUEUE_DIR).
@@ -185,13 +259,7 @@ def _serve(args) -> int:
     crawler.start()
     server.crawler = crawler
 
-    stop = []
-    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
-    try:
-        while not stop:
-            signal.pause()
-    except KeyboardInterrupt:
-        pass
+    _wait_for_sigterm()
     crawler.stop()
     server.stop()
     return 0

@@ -1,0 +1,6 @@
+"""Gateway backends: serve the S3 API over foreign storage (ref
+Gateway interface, cmd/gateway-interface.go:34 — NewGatewayLayer(creds)
+returns an ObjectLayer; backends cmd/gateway/{nas,s3,...})."""
+
+from .nas import NASGateway  # noqa: F401
+from .s3 import S3Gateway  # noqa: F401

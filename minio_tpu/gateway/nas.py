@@ -1,0 +1,17 @@
+"""NAS gateway: the S3 front end over one POSIX mount (ref
+cmd/gateway/nas/gateway-nas.go, 121 LoC — it literally returns the FS
+ObjectLayer over the given path; so do we)."""
+
+from __future__ import annotations
+
+from ..fs.backend import FSObjects
+
+
+class NASGateway:
+    name = "nas"
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def new_gateway_layer(self):
+        return FSObjects(self.path)

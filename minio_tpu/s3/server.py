@@ -449,6 +449,8 @@ class S3ApiHandlers:
         newS2CompressReader wrap, cmd/object-api-utils.go:436,898)."""
         from ..crypto import sse
         from ..utils import compress
+        if not getattr(self.layer, "supports_transforms", True):
+            return body  # gateway: upstream gets the raw payload
         if not self.compress_enabled:
             return body
         if not compress.is_compressible(
@@ -475,6 +477,12 @@ class S3ApiHandlers:
             ckey = sse.parse_ssec_key(req.headers)
         except sse.SSEError:
             raise s3err.ERR_INVALID_SSE_PARAMS
+        if not getattr(self.layer, "supports_transforms", True):
+            if ckey is not None or req.headers.get(sse.H_SSE):
+                # No local envelope through a gateway (the reference
+                # rejects SSE in gateway mode without backend SSE).
+                raise s3err.ERR_NOT_IMPLEMENTED
+            return None
         if ckey is not None:
             return sse.SSE_C, ckey
         if (req.headers.get(sse.H_SSE) == "AES256"
@@ -1260,7 +1268,17 @@ class S3ApiHandlers:
                 raise s3err.ERR_NO_SUCH_KEY
             root = Element("Tagging", S3_XMLNS)
             tagset = root.child("TagSet")
-            raw = info.metadata.get("x-amz-tagging", "")
+            if hasattr(self.layer, "get_object_tags"):
+                # Gateway layers fetch tags from the upstream.
+                try:
+                    raw = self.layer.get_object_tags(
+                        req.bucket, req.key, version_id)
+                except (ObjectNotFound, BucketNotFound):
+                    raise s3err.ERR_NO_SUCH_KEY
+                except MethodNotAllowed:
+                    raise s3err.ERR_METHOD_NOT_ALLOWED
+            else:
+                raw = info.metadata.get("x-amz-tagging", "")
             for pair in raw.split("&") if raw else []:
                 k, _, v = pair.partition("=")
                 t = tagset.child("Tag")

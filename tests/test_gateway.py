@@ -1,0 +1,162 @@
+"""Gateway backends: NAS (FS over a mount) and S3 (remote upstream)
+(ref cmd/gateway-interface.go, cmd/gateway/nas, cmd/gateway/s3)."""
+
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from minio_tpu.erasure.engine import (BucketNotFound, ErasureObjects,
+                                      ObjectNotFound)
+from minio_tpu.gateway import NASGateway, S3Gateway
+from minio_tpu.s3.client import S3Client
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.xl import XLStorage
+
+ACCESS, SECRET = "gwadmin", "gwadmin-secret"
+
+
+@pytest.fixture(scope="module")
+def upstream(tmp_path_factory):
+    """The remote store the s3 gateway fronts — a real erasure server."""
+    root = tmp_path_factory.mktemp("gw-upstream")
+    disks = [XLStorage(str(root / f"d{i}")) for i in range(4)]
+    srv = S3Server(ErasureObjects(disks, block_size=64 * 1024),
+                   ACCESS, SECRET)
+    port = srv.start()
+    yield srv, port
+    srv.stop()
+
+
+@pytest.fixture(scope="module")
+def gw(upstream, tmp_path_factory):
+    """An S3-gateway server chained in front of the upstream."""
+    _, up_port = upstream
+    meta = tmp_path_factory.mktemp("gw-meta")
+    layer = S3Gateway("127.0.0.1", up_port, ACCESS, SECRET,
+                      str(meta)).new_gateway_layer()
+    srv = S3Server(layer, ACCESS, SECRET)
+    port = srv.start()
+    yield srv, port
+    srv.stop()
+
+
+@pytest.fixture
+def gclient(gw):
+    _, port = gw
+    return S3Client("127.0.0.1", port, ACCESS, SECRET)
+
+
+@pytest.fixture
+def uclient(upstream):
+    _, port = upstream
+    return S3Client("127.0.0.1", port, ACCESS, SECRET)
+
+
+def test_s3_gateway_roundtrip(gclient, uclient):
+    assert gclient.make_bucket("gwb").status == 200
+    body = bytes(range(256)) * 64
+    r = gclient.put_object("gwb", "deep/obj.bin", body,
+                           headers={"x-amz-meta-site": "edge",
+                                    "content-type": "application/x-t"})
+    assert r.status == 200
+    # Visible through the gateway AND directly on the upstream.
+    g = gclient.get_object("gwb", "deep/obj.bin")
+    assert g.status == 200 and g.body == body
+    assert g.headers.get("x-amz-meta-site") == "edge"
+    assert uclient.get_object("gwb", "deep/obj.bin").body == body
+    # HEAD + range.
+    h = gclient.head_object("gwb", "deep/obj.bin")
+    assert h.status == 200 and h.headers["content-length"] == str(
+        len(body))
+    rng = gclient.get_object("gwb", "deep/obj.bin",
+                             headers={"range": "bytes=256-511"})
+    assert rng.status == 206 and rng.body == bytes(range(256))
+
+
+def test_s3_gateway_listing(gclient):
+    gclient.make_bucket("gwlist")
+    for i in range(5):
+        gclient.put_object("gwlist", f"a/k{i}", b"x")
+    gclient.put_object("gwlist", "b/other", b"y")
+    r = gclient.list_objects_v2("gwlist", prefix="a/")
+    root = ET.fromstring(r.body)
+    keys = [e.text for e in root.iter(
+        "{http://s3.amazonaws.com/doc/2006-03-01/}Key")]
+    assert keys == [f"a/k{i}" for i in range(5)]
+    # ListBuckets through the gateway includes both buckets.
+    r = gclient.request("GET", "/")
+    assert b"gwlist" in r.body
+
+
+def test_s3_gateway_delete_and_404(gclient):
+    gclient.make_bucket("gwdel")
+    gclient.put_object("gwdel", "k", b"x")
+    assert gclient.delete_object("gwdel", "k").status == 204
+    assert gclient.get_object("gwdel", "k").status == 404
+    assert gclient.get_object("gwdel", "never").status == 404
+    assert gclient.head_object("nosuchbkt", "k").status == 404
+
+
+def test_s3_gateway_multipart(gclient, uclient):
+    gclient.make_bucket("gwmp")
+    r = gclient.request("POST", "/gwmp/big.bin", query="uploads")
+    assert r.status == 200
+    upload_id = ET.fromstring(r.body).findtext(
+        "{http://s3.amazonaws.com/doc/2006-03-01/}UploadId")
+    assert upload_id
+    part = b"P" * (5 * 1024 * 1024)
+    etags = []
+    for n in (1, 2):
+        r = gclient.request(
+            "PUT", "/gwmp/big.bin",
+            query=f"partNumber={n}&uploadId={upload_id}", body=part)
+        assert r.status == 200, r.body
+        etags.append((n, r.headers["etag"].strip('"')))
+    doc = "<CompleteMultipartUpload>" + "".join(
+        f"<Part><PartNumber>{n}</PartNumber><ETag>\"{e}\"</ETag></Part>"
+        for n, e in etags) + "</CompleteMultipartUpload>"
+    r = gclient.request("POST", "/gwmp/big.bin",
+                        query=f"uploadId={upload_id}",
+                        body=doc.encode())
+    assert r.status == 200, r.body
+    g = uclient.get_object("gwmp", "big.bin")
+    assert g.status == 200 and g.body == part * 2
+
+
+def test_s3_gateway_tagging(gclient):
+    gclient.make_bucket("gwtag")
+    gclient.put_object("gwtag", "k", b"x")
+    r = gclient.request("PUT", "/gwtag/k", query="tagging",
+                        body=b"<Tagging><TagSet><Tag><Key>team</Key>"
+                             b"<Value>infra</Value></Tag></TagSet>"
+                             b"</Tagging>")
+    assert r.status == 200, r.body
+    r = gclient.get_object("gwtag", "k", query="tagging")
+    assert b"team" in r.body and b"infra" in r.body
+
+
+def test_nas_gateway_layer(tmp_path):
+    layer = NASGateway(str(tmp_path / "mnt")).new_gateway_layer()
+    srv = S3Server(layer, ACCESS, SECRET)
+    port = srv.start()
+    try:
+        c = S3Client("127.0.0.1", port, ACCESS, SECRET)
+        c.make_bucket("nasb")
+        c.put_object("nasb", "dir/f.txt", b"nas-bytes")
+        assert c.get_object("nasb", "dir/f.txt").body == b"nas-bytes"
+        # The object is a plain file on the mount (NAS semantics).
+        assert (tmp_path / "mnt" / "nasb" / "dir" /
+                "f.txt").read_bytes() == b"nas-bytes"
+    finally:
+        srv.stop()
+
+
+def test_gateway_layer_errors(upstream, tmp_path):
+    _, up_port = upstream
+    layer = S3Gateway("127.0.0.1", up_port, ACCESS, SECRET,
+                      str(tmp_path / "meta")).new_gateway_layer()
+    with pytest.raises(BucketNotFound):
+        layer.get_object("nope-bucket-xyz", "k")
+    layer.make_bucket("gwerr")
+    with pytest.raises(ObjectNotFound):
+        layer.get_object("gwerr", "missing")
