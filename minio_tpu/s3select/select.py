@@ -63,12 +63,15 @@ def parse_request(body: bytes) -> dict:
         req["input"]["json"] = {
             "Type": json_el.findtext("Type") or "LINES"}
     elif parquet_el is not None:
-        raise S3SelectError(
-            "UnsupportedFormat",
-            "Parquet input is not supported by this build")
+        req["input"]["format"] = "Parquet"
+        if req["input"]["compression"] not in ("", "NONE"):
+            raise S3SelectError(
+                "InvalidRequestParameter",
+                "CompressionType must be NONE for Parquet input")
     else:
-        raise S3SelectError("MalformedXML",
-                            "InputSerialization needs CSV or JSON")
+        raise S3SelectError(
+            "MalformedXML",
+            "InputSerialization needs CSV, JSON or Parquet")
     outs = root.find("OutputSerialization")
     if outs is None:
         raise S3SelectError("MalformedXML",
@@ -101,8 +104,19 @@ def run_select(req: dict, data: bytes) -> bytes:
     full event-stream response body."""
     raw_len = len(data)
     try:
-        data = readers.decompress(data, req["input"].get("compression"))
-        if req["input"]["format"] == "CSV":
+        fmt = req["input"]["format"]
+        if fmt == "Parquet":
+            # Parquet is never additionally whole-object compressed
+            # (pages carry their own codec, ref S3 API).
+            from .parquet import ParquetError, parquet_records
+            try:
+                records = list(parquet_records(data))
+            except ParquetError as e:
+                raise S3SelectError("InvalidDataSource", str(e))
+        else:
+            data = readers.decompress(data,
+                                      req["input"].get("compression"))
+        if fmt == "CSV":
             c = req["input"]["csv"]
             records = readers.csv_records(
                 data,
@@ -112,7 +126,7 @@ def run_select(req: dict, data: bytes) -> bytes:
                 quote_character=c["QuoteCharacter"],
                 quote_escape_character=c["QuoteEscapeCharacter"],
                 comments=c["Comments"])
-        else:
+        elif fmt == "JSON":
             records = readers.json_records(
                 data, json_type=req["input"]["json"]["Type"])
         query = sql.parse(req["expression"])
