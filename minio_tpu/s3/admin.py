@@ -342,6 +342,105 @@ class AdminHandlers:
                 "itemsHealed": seq["healed"],
                 "items": seq["items"][-1000:]}
 
+    # -- OBD / health info (ref cmd/healthinfo.go, admin /obdinfo;
+    # pkg/smart, pkg/disk) ---------------------------------------------
+
+    def h_obd_info(self, p, body):
+        """Hardware + perf diagnostics bundle: cpu/mem/os plus a small
+        per-disk write+read latency probe (ref the drive perf section
+        of the OBD handler)."""
+        import os as _os
+        import platform
+
+        info = {
+            "os": {"platform": platform.platform(),
+                   "python": platform.python_version()},
+            "cpu": {"count": _os.cpu_count(),
+                    "loadavg": list(_os.getloadavg())},
+            "mem": self._meminfo(),
+            "drives": [],
+        }
+        layer = self.server.layer
+        probe = p.get("drivePerf") == "true"
+        for pool in _pools(layer):
+            for es in pool.sets:
+                for d in es.disks:
+                    ent = {"endpoint": getattr(d, "root",
+                                               str(d))}
+                    try:
+                        ent.update(d.disk_info())
+                        ent["online"] = True
+                        if probe:
+                            ent["perf"] = self._drive_perf(d)
+                    except Exception as e:  # noqa: BLE001
+                        ent["online"] = False
+                        ent["error"] = str(e)
+                    info["drives"].append(ent)
+        return info
+
+    @staticmethod
+    def _meminfo() -> dict:
+        out = {}
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    k, _, v = line.partition(":")
+                    if k in ("MemTotal", "MemAvailable"):
+                        out[k] = int(v.strip().split()[0]) * 1024
+        except OSError:
+            pass
+        return out
+
+    @staticmethod
+    def _drive_perf(disk) -> dict:
+        """4KiB write+read latency probe on one drive (ref the
+        dperf-style measurement in the OBD drive section)."""
+        payload = b"\0" * 4096
+        path = "obd-perf-probe"
+        t0 = time.perf_counter()
+        disk.write_all(".minio.sys", f"tmp/{path}", payload)
+        w_ms = (time.perf_counter() - t0) * 1000
+        t0 = time.perf_counter()
+        disk.read_all(".minio.sys", f"tmp/{path}")
+        r_ms = (time.perf_counter() - t0) * 1000
+        try:
+            disk.delete(".minio.sys", f"tmp/{path}")
+        except Exception:
+            pass
+        return {"writeLatencyMs": round(w_ms, 3),
+                "readLatencyMs": round(r_ms, 3)}
+
+    # -- profiling (ref admin /profiling/start, cmd/utils.go:230
+    # globalProfiler — Python analog: cProfile) ------------------------
+
+    def h_profiling_start(self, p, body):
+        from ..utils.profiler import SamplingProfiler
+        if getattr(self, "_profiler", None) is not None:
+            raise ValueError("profiling already running")
+        self._profiler = SamplingProfiler(
+            interval=float(p.get("intervalMs", "5")) / 1000.0)
+        self._profiler.start()
+        return {"ok": True}
+
+    def h_profiling_stop(self, p, body):
+        prof = getattr(self, "_profiler", None)
+        if prof is None:
+            raise ValueError("profiling not running")
+        self._profiler = None
+        return {"profile": prof.stop()}
+
+    # -- bandwidth (ref pkg/bandwidth, admin /bandwidth route,
+    # cmd/admin-router.go:217) -----------------------------------------
+
+    def h_bandwidth(self, p, body):
+        report = self.server.bandwidth.report()
+        bucket = p.get("bucket", "")
+        if bucket:
+            report = {bucket: report.get(bucket, {
+                "rxBytesWindow": 0, "txBytesWindow": 0,
+                "rxRateBps": 0.0, "txRateBps": 0.0})}
+        return {"buckets": report, "windowSeconds": 60}
+
     # -- disk cache ----------------------------------------------------
 
     def h_cache_stats(self, p, body):

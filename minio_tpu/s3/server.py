@@ -1560,13 +1560,17 @@ class S3Server:
         self.config = None  # ConfigSys once the layer attaches
         self.audit = None
         self._audit_from_env = False
+        from .webrpc import WebHandlers
+        self.web = WebHandlers(self)
         if layer is not None:
             self.set_layer(layer)
         from .admin import AdminHandlers, Metrics
         self.metrics = Metrics()
         self.admin = AdminHandlers(self)
         from ..logger.audit import AuditWebhook
+        from ..utils.bandwidth import BandwidthMonitor
         from ..utils.pubsub import PubSub
+        self.bandwidth = BandwidthMonitor()
         # Every request publishes a trace.Info analog here; admin
         # /trace subscribes (ref globalHTTPTrace, cmd/globals.go:184).
         self.trace_hub = PubSub()
@@ -1952,6 +1956,15 @@ class S3Server:
         if raw_path == "/minio-tpu/metrics":
             text = self.metrics.prometheus(self.layer)
             return 200, "text/plain; version=0.0.4", text.encode()
+        if raw_path == "/minio-tpu/webrpc" and method == "POST":
+            out = self.web.handle_rpc(headers, body)
+            return 200, "application/json", out
+        if raw_path.startswith("/minio-tpu/web/upload/") and \
+                method == "PUT":
+            return self.web.handle_upload(raw_path, headers, body)
+        if raw_path.startswith("/minio-tpu/web/download/") and \
+                method == "GET":
+            return self.web.handle_download(raw_path, query)
         if raw_path.startswith("/minio-tpu/admin/"):
             try:
                 req = S3Request(method, raw_path, query, headers, body)
@@ -2108,6 +2121,8 @@ class S3Server:
                            f"{'object' if req.key else 'bucket' if req.bucket else 'service'}")
                     server.metrics.record(api, resp.status, len(body),
                                           len(resp.body))
+                    server.bandwidth.record(req.bucket, len(body),
+                                            len(resp.body))
                     server.publish_trace(
                         api, self.command, raw_path, resp.status,
                         (time.monotonic() - t0) * 1000.0, len(body),

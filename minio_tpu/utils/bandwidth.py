@@ -1,0 +1,62 @@
+"""Per-bucket bandwidth accounting (ref pkg/bandwidth — the monitor
+behind `mc admin bwinfo`, tracking replication/data bandwidth per
+bucket over a sliding window).
+
+Fixed one-second accumulator slots: O(1) record, O(window) report,
+bounded memory regardless of request rate.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+WINDOW_SECONDS = 60
+
+
+class BandwidthMonitor:
+    def __init__(self):
+        self._mu = threading.Lock()
+        # bucket -> {epoch_second: [rx, tx]}
+        self._slots: dict[str, dict[int, list[int]]] = {}
+
+    def record(self, bucket: str, rx: int, tx: int) -> None:
+        if not bucket or (rx == 0 and tx == 0):
+            return
+        sec = int(time.time())
+        with self._mu:
+            slots = self._slots.setdefault(bucket, {})
+            slot = slots.get(sec)
+            if slot is None:
+                slots[sec] = [rx, tx]
+                if len(slots) > WINDOW_SECONDS + 2:
+                    self._trim(slots, sec)
+            else:
+                slot[0] += rx
+                slot[1] += tx
+
+    @staticmethod
+    def _trim(slots: dict[int, list[int]], now_sec: int) -> None:
+        cutoff = now_sec - WINDOW_SECONDS
+        for s in [s for s in slots if s < cutoff]:
+            del slots[s]
+
+    def report(self) -> dict:
+        """{bucket: {rxBytesWindow, txBytesWindow, rxRateBps,
+        txRateBps}} over the last WINDOW_SECONDS."""
+        now_sec = int(time.time())
+        out = {}
+        with self._mu:
+            for bucket, slots in list(self._slots.items()):
+                self._trim(slots, now_sec)
+                if not slots:
+                    del self._slots[bucket]
+                    continue
+                rx = sum(v[0] for v in slots.values())
+                tx = sum(v[1] for v in slots.values())
+                out[bucket] = {
+                    "rxBytesWindow": rx, "txBytesWindow": tx,
+                    "rxRateBps": rx / WINDOW_SECONDS,
+                    "txRateBps": tx / WINDOW_SECONDS,
+                }
+        return out

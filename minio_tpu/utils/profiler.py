@@ -1,0 +1,82 @@
+"""Sampling profiler covering ALL threads (the admin /profiling
+endpoints' engine; ref cmd/utils.go:230 globalProfiler — the reference
+collects whole-process pprof profiles, so a per-thread cProfile would
+miss every request handler thread).
+
+A sampler thread walks sys._current_frames() on an interval and
+aggregates inclusive sample counts per frame; the report is a flat
+"top functions" table like `pprof -top`.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import Counter
+
+
+class SamplingProfiler:
+    def __init__(self, interval: float = 0.005):
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.samples = 0
+        self.leaf_counts: Counter = Counter()   # executing function
+        self.stack_counts: Counter = Counter()  # anywhere on stack
+        self.started_at = 0.0
+
+    def start(self) -> None:
+        if self._thread is not None:
+            raise RuntimeError("profiler already running")
+        self._stop.clear()
+        self.started_at = time.time()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="sampling-profiler")
+        self._thread.start()
+
+    def _run(self) -> None:
+        me = threading.get_ident()
+        while not self._stop.wait(self.interval):
+            self.samples += 1
+            for tid, frame in sys._current_frames().items():
+                if tid == me:
+                    continue
+                seen = set()
+                leaf = True
+                while frame is not None:
+                    code = frame.f_code
+                    key = (code.co_filename, code.co_firstlineno,
+                           code.co_name)
+                    if leaf:
+                        self.leaf_counts[key] += 1
+                        leaf = False
+                    if key not in seen:
+                        seen.add(key)
+                        self.stack_counts[key] += 1
+                    frame = frame.f_back
+
+    def stop(self) -> dict:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+        return self.report()
+
+    def report(self, top: int = 50) -> dict:
+        def rows(counter: Counter) -> list[dict]:
+            total = max(1, self.samples)
+            return [{
+                "function": f"{name} ({file.rsplit('/', 1)[-1]}:"
+                            f"{line})",
+                "samples": n,
+                "pct": round(100.0 * n / total, 1),
+            } for (file, line, name), n in counter.most_common(top)]
+
+        return {
+            "durationSeconds": round(time.time() - self.started_at, 2),
+            "samples": self.samples,
+            "intervalMs": self.interval * 1000,
+            "self": rows(self.leaf_counts),
+            "cumulative": rows(self.stack_counts),
+        }

@@ -1,0 +1,108 @@
+"""OBD health info, profiling, bandwidth monitor (ref
+cmd/healthinfo.go, admin /profiling, pkg/bandwidth)."""
+
+import json
+import time
+
+import pytest
+
+from minio_tpu.erasure.engine import ErasureObjects
+from minio_tpu.s3.client import S3Client
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.xl import XLStorage
+from minio_tpu.utils.bandwidth import BandwidthMonitor
+
+ACCESS, SECRET = "obdadmin", "obdadmin-secret"
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    root = tmp_path_factory.mktemp("obddisks")
+    disks = [XLStorage(str(root / f"d{i}")) for i in range(4)]
+    srv = S3Server(ErasureObjects(disks, block_size=64 * 1024),
+                   ACCESS, SECRET)
+    port = srv.start()
+    yield srv, port
+    srv.stop()
+
+
+@pytest.fixture
+def client(server):
+    _, port = server
+    return S3Client("127.0.0.1", port, ACCESS, SECRET)
+
+
+def test_obd_info(client):
+    r = client.request("GET", "/minio-tpu/admin/v1/obd-info",
+                       query="drivePerf=true")
+    assert r.status == 200, r.body
+    doc = json.loads(r.body)
+    assert doc["cpu"]["count"] >= 1
+    assert len(doc["drives"]) == 4
+    for d in doc["drives"]:
+        assert d["online"] is True
+        assert d["perf"]["writeLatencyMs"] > 0
+        assert d["perf"]["readLatencyMs"] > 0
+    # Without drivePerf the probe is skipped.
+    r = client.request("GET", "/minio-tpu/admin/v1/obd-info")
+    doc = json.loads(r.body)
+    assert "perf" not in doc["drives"][0]
+
+
+def test_profiling_roundtrip(client):
+    r = client.request("POST", "/minio-tpu/admin/v1/profiling-start",
+                       query="intervalMs=2")
+    assert r.status == 200
+    # double start rejected
+    r = client.request("POST", "/minio-tpu/admin/v1/profiling-start")
+    assert r.status == 400
+    # generate server work ACROSS REQUEST THREADS to profile
+    client.make_bucket("profb")
+    for i in range(20):
+        client.put_object("profb", f"x{i}", b"y" * 20000)
+        client.get_object("profb", f"x{i}")
+    r = client.request("POST", "/minio-tpu/admin/v1/profiling-stop")
+    assert r.status == 200
+    prof = json.loads(r.body)["profile"]
+    assert prof["samples"] > 0
+    # The sampler must have seen the actual request handlers, not just
+    # the admin thread (the per-thread cProfile failure mode).
+    all_fns = " ".join(row["function"]
+                       for row in prof["cumulative"])
+    assert "_handle" in all_fns or "route" in all_fns, all_fns
+    # stop without start rejected
+    r = client.request("POST", "/minio-tpu/admin/v1/profiling-stop")
+    assert r.status == 400
+
+
+def test_bandwidth_admin(client):
+    client.make_bucket("bwb")
+    payload = b"B" * 50_000
+    client.put_object("bwb", "big", payload)
+    client.get_object("bwb", "big")
+    r = client.request("GET", "/minio-tpu/admin/v1/bandwidth",
+                       query="bucket=bwb")
+    doc = json.loads(r.body)
+    b = doc["buckets"]["bwb"]
+    assert b["rxBytesWindow"] >= 50_000    # the PUT body
+    assert b["txBytesWindow"] >= 50_000    # the GET response
+    assert b["rxRateBps"] > 0
+
+
+def test_bandwidth_monitor_window():
+    bw = BandwidthMonitor()
+    bw.record("b", 100, 200)
+    bw.record("b", 1, 2)  # same-second accumulation
+    rep = bw.report()["b"]
+    assert (rep["rxBytesWindow"], rep["txBytesWindow"]) == (101, 202)
+    # Slots older than the window are trimmed away.
+    import time as _t
+    bw._slots["b"][int(_t.time()) - 120] = [9999, 9999]
+    rep = bw.report()["b"]
+    assert rep["rxBytesWindow"] == 101
+    # A bucket whose slots all expired disappears from the report.
+    bw._slots["stale"] = {int(_t.time()) - 120: [5, 5]}
+    assert "stale" not in bw.report()
+    # Empty bucket names are ignored.
+    bw.record("", 10, 10)
+    assert "" not in bw.report()
