@@ -43,11 +43,12 @@ class BucketMetadata:
     replication_xml: str = ""       # <ReplicationConfiguration>
     quota: dict | None = None       # {"quota": bytes, "quotaType": "hard"}
     replication_targets: list = field(default_factory=list)
+    cors_xml: str = ""              # <CORSConfiguration>
 
     _FIELDS = ("name", "created", "versioning", "policy", "tagging_xml",
                "lifecycle_xml", "notification_xml", "sse_xml",
                "object_lock_xml", "replication_xml", "quota",
-               "replication_targets")
+               "replication_targets", "cors_xml")
 
     def to_dict(self) -> dict:
         return {k: getattr(self, k) for k in self._FIELDS}

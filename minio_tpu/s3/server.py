@@ -29,6 +29,14 @@ from .xmlutil import S3_XMLNS, Element, parse
 MAX_OBJECT_SIZE = 5 * 1024 * 1024 * 1024  # single-PUT cap (5 GiB)
 
 
+def _mime_for(key: str) -> str:
+    """Content type from the key's extension (ref pkg/mimedb — the
+    reference ships a 4.6k-line codegen table; Python's mimetypes
+    covers the same registry)."""
+    import mimetypes
+    return mimetypes.guess_type(key)[0] or "application/octet-stream"
+
+
 def _iso8601(t: float) -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(t))
 
@@ -93,6 +101,43 @@ class S3Response:
         self.status = status
         self.body = body
         self.headers = headers or {}
+
+
+def check_preconditions(req: "S3Request", info: "ObjectInfo",
+                        prefix: str = "") -> int:
+    """Evaluate conditional headers against the object; returns 0 (ok),
+    304 or 412 (ref checkPreconditions, cmd/object-handlers-common.go;
+    copy-source variants use the x-amz-copy-source-if-* names)."""
+    h = req.headers
+    etag = info.etag
+    not_modified = (304 if req.method in ("GET", "HEAD") and not prefix
+                    else 412)
+    if_match = h.get(f"{prefix}if-match", "")
+    if if_match:
+        if if_match.strip('"') != etag and if_match != "*":
+            return 412
+        # A passing If-Match supersedes If-Unmodified-Since (RFC 7232
+        # §6 / ref checkPreconditions ordering).
+    elif (ius := h.get(f"{prefix}if-unmodified-since", "")):
+        try:
+            t = email.utils.parsedate_to_datetime(ius).timestamp()
+            if info.mod_time > t:
+                return 412
+        except (TypeError, ValueError):
+            pass
+    if_none = h.get(f"{prefix}if-none-match", "")
+    if if_none:
+        if if_none == "*" or if_none.strip('"') == etag:
+            return not_modified
+        # If-None-Match present: If-Modified-Since is IGNORED.
+    elif (ims := h.get(f"{prefix}if-modified-since", "")):
+        try:
+            t = email.utils.parsedate_to_datetime(ims).timestamp()
+            if info.mod_time <= t:
+                return not_modified
+        except (TypeError, ValueError):
+            pass
+    return 0
 
 
 class S3ApiHandlers:
@@ -632,8 +677,8 @@ class S3ApiHandlers:
             want = base64.b64decode(md5_header)
             if hashlib.md5(req.body).digest() != want:
                 raise s3err.ERR_BAD_DIGEST
-        meta = {"content-type": req.headers.get(
-            "content-type", "application/octet-stream")}
+        meta = {"content-type": req.headers.get("content-type")
+                or _mime_for(req.key)}
         for k, v in req.headers.items():
             if k.startswith("x-amz-meta-"):
                 meta[k] = v
@@ -681,6 +726,9 @@ class S3ApiHandlers:
                 req, bucket=sbucket, key=skey, copy_source=True)
         except (ObjectNotFound, BucketNotFound):
             raise s3err.ERR_NO_SUCH_KEY
+        if check_preconditions(req, sinfo,
+                               prefix="x-amz-copy-source-"):
+            raise s3err.ERR_PRECONDITION_FAILED
         meta = dict(sinfo.metadata)
         if req.headers.get("x-amz-metadata-directive") == "REPLACE":
             meta = {"content-type": req.headers.get(
@@ -779,6 +827,12 @@ class S3ApiHandlers:
             # Ranges address the PLAINTEXT for transformed objects (ref
             # DecryptObjectInfo size rewrite).
             size = self._actual_size(info)
+            status = check_preconditions(req, info)
+            if status == 304:
+                return S3Response(304, b"",
+                                  self._object_headers(info))
+            if status == 412:
+                raise s3err.ERR_PRECONDITION_FAILED
             rng = _parse_range(req.headers.get("range", ""), size)
             data = b""
             if not head:
@@ -884,6 +938,55 @@ class S3ApiHandlers:
         root.child("Bucket", req.bucket)
         root.child("Key", req.key)
         root.child("UploadId", upload_id)
+        return S3Response(200, root.tobytes(),
+                          {"Content-Type": "application/xml"})
+
+    def upload_part_copy(self, req: S3Request) -> S3Response:
+        """PUT ?partNumber&uploadId with x-amz-copy-source: source
+        bytes (optionally x-amz-copy-source-range) become the part
+        (ref CopyObjectPartHandler, cmd/object-handlers.go)."""
+        from ..erasure.multipart import InvalidPart, UploadNotFound
+        src = urllib.parse.unquote(req.headers["x-amz-copy-source"])
+        src = src.lstrip("/")
+        if "/" not in src:
+            raise s3err.ERR_INVALID_ARGUMENT
+        sbucket, skey = src.split("/", 1)
+        try:
+            data, sinfo = self._read_object_plain(
+                req, bucket=sbucket, key=skey, copy_source=True)
+        except (ObjectNotFound, BucketNotFound):
+            raise s3err.ERR_NO_SUCH_KEY
+        if check_preconditions(req, sinfo,
+                               prefix="x-amz-copy-source-"):
+            raise s3err.ERR_PRECONDITION_FAILED
+        rng = req.headers.get("x-amz-copy-source-range", "")
+        if rng:
+            parsed = _parse_range(rng, len(data))
+            if parsed is None:
+                raise s3err.ERR_INVALID_ARGUMENT
+            off, ln = parsed
+            data = data[off:off + ln]
+        if len(data) > MAX_OBJECT_SIZE:
+            raise s3err.ERR_ENTITY_TOO_LARGE
+        self._check_quota(req.bucket, len(data))
+        part_number = int(req.params["partNumber"])
+        body, actual = data, None
+        pkey = self._sse_part_key(req, part_number)
+        if pkey is not None:
+            from ..crypto import sse
+            body = sse.encrypt_stream(data, pkey)
+            actual = len(data)
+        try:
+            part = self.layer.multipart.put_object_part(
+                req.bucket, req.key, req.params["uploadId"],
+                part_number, body, actual_size=actual)
+        except UploadNotFound:
+            raise s3err.ERR_NO_SUCH_UPLOAD
+        except (InvalidPart, ValueError):
+            raise s3err.ERR_INVALID_ARGUMENT
+        root = Element("CopyPartResult", S3_XMLNS)
+        root.child("ETag", f'"{part["etag"]}"')
+        root.child("LastModified", _iso8601(time.time()))
         return S3Response(200, root.tobytes(),
                           {"Content-Type": "application/xml"})
 
@@ -1253,6 +1356,59 @@ class S3ApiHandlers:
         return self._xml_config(req, "replication_xml",
                                 "ReplicationConfiguration",
                                 s3err.ERR_NO_SUCH_REPLICATION_CONFIG)
+
+    def bucket_cors(self, req: S3Request) -> S3Response:
+        return self._xml_config(req, "cors_xml", "CORSConfiguration",
+                                s3err.ERR_NO_SUCH_CORS_CONFIG)
+
+    # ---------------- CORS evaluation ----------------
+
+    def cors_rules(self, bucket: str) -> list[dict]:
+        raw = self.bucket_meta.get(bucket).cors_xml
+        if not raw:
+            return []
+        try:
+            doc = parse(raw.encode())
+        except Exception:
+            return []
+        rules = []
+        for r in doc.findall("CORSRule"):
+            rules.append({
+                "origins": [e.text or "" for e in
+                            r.findall("AllowedOrigin")],
+                "methods": [(e.text or "").upper() for e in
+                            r.findall("AllowedMethod")],
+                "headers": [(e.text or "").lower() for e in
+                            r.findall("AllowedHeader")],
+                "expose": [e.text or "" for e in
+                           r.findall("ExposeHeader")],
+                "max_age": r.findtext("MaxAgeSeconds") or "",
+            })
+        return rules
+
+    @staticmethod
+    def _origin_matches(pattern: str, origin: str) -> bool:
+        if pattern == "*":
+            return True
+        if "*" in pattern:
+            pre, _, post = pattern.partition("*")
+            return (origin.startswith(pre) and origin.endswith(post)
+                    and len(origin) >= len(pre) + len(post))
+        return pattern == origin
+
+    def cors_match(self, bucket: str, origin: str,
+                   method: str) -> dict | None:
+        """First rule allowing (origin, method), else None (ref the
+        CORS filter the reference serves from bucket metadata)."""
+        if not origin:
+            return None
+        for rule in self.cors_rules(bucket):
+            if method.upper() not in rule["methods"]:
+                continue
+            if any(self._origin_matches(p, origin)
+                   for p in rule["origins"]):
+                return rule
+        return None
 
     # ---------------- object tagging ----------------
 
@@ -1667,6 +1823,11 @@ class S3Server:
         return self.secret_key if access_key == self.access_key else None
 
     def authenticate(self, req: S3Request) -> str:
+        if req.headers.get("authorization", "").startswith("AWS "):
+            # Legacy V2 signature (ref cmd/signature-v2.go).
+            return sigv4.verify_header_auth_v2(
+                req.method, req.raw_path, req.query, req.headers,
+                self._lookup_secret)
         if "authorization" in req.headers:
             ak = sigv4.verify_header_auth(
                 req.method, req.raw_path, req.query, req.headers,
@@ -1741,6 +1902,9 @@ class S3Server:
             if "replication" in p:
                 return ("s3:GetReplicationConfiguration" if m == "GET"
                         else "s3:PutReplicationConfiguration", resource)
+            if "cors" in p:
+                return ("s3:GetBucketCORS" if m == "GET"
+                        else "s3:PutBucketCORS", resource)
             if "versions" in p:
                 return "s3:ListBucketVersions", resource
             if m == "PUT":
@@ -1888,7 +2052,8 @@ class S3Server:
                               ("encryption", h.bucket_encryption),
                               ("tagging", h.bucket_tagging),
                               ("object-lock", h.bucket_object_lock),
-                              ("replication", h.bucket_replication)):
+                              ("replication", h.bucket_replication),
+                              ("cors", h.bucket_cors)):
                 if param in p:
                     return fn(req)
             if m == "PUT":
@@ -1921,6 +2086,8 @@ class S3Server:
         if m == "POST" and "uploadId" in p:
             return h.complete_multipart(req)
         if m == "PUT" and "partNumber" in p and "uploadId" in p:
+            if "x-amz-copy-source" in req.headers:
+                return h.upload_part_copy(req)
             return h.put_part(req)
         if m == "DELETE" and "uploadId" in p:
             return h.abort_multipart(req)
@@ -2132,6 +2299,18 @@ class S3Server:
                     self.send_response(resp.status)
                     self.send_header("x-amz-request-id", req.request_id)
                     self.send_header("Server", "MinIO-TPU")
+                    origin = headers.get("origin", "")
+                    if origin and req.bucket and \
+                            server.handlers is not None:
+                        rule = server.handlers.cors_match(
+                            req.bucket, origin, self.command)
+                        if rule is not None:
+                            self.send_header(
+                                "Access-Control-Allow-Origin", origin)
+                            if rule["expose"]:
+                                self.send_header(
+                                    "Access-Control-Expose-Headers",
+                                    ", ".join(rule["expose"]))
                     for k, v in resp.headers.items():
                         self.send_header(k, v)
                     if "Content-Length" not in resp.headers:
@@ -2142,6 +2321,45 @@ class S3Server:
                         self.wfile.write(resp.body)
                 except (BrokenPipeError, ConnectionResetError):
                     pass
+
+            def do_OPTIONS(self):
+                """CORS preflight: unauthenticated by design (ref the
+                preflight path of the CORS middleware)."""
+                raw_path, _, _q = self.path.partition("?")
+                headers = {k.lower(): v for k, v in self.headers.items()}
+                origin = headers.get("origin", "")
+                want = headers.get("access-control-request-method", "")
+                want_headers = [
+                    x.strip().lower() for x in headers.get(
+                        "access-control-request-headers", ""
+                    ).split(",") if x.strip()]
+                bucket = raw_path.lstrip("/").split("/", 1)[0]
+                rule = None
+                if bucket and server.handlers is not None:
+                    rule = server.handlers.cors_match(bucket, origin,
+                                                      want)
+                if rule is not None and want_headers:
+                    allowed = rule["headers"]
+                    if "*" not in allowed and any(
+                            hh not in allowed for hh in want_headers):
+                        rule = None  # requested header not allowed
+                if rule is None:
+                    self.send_response(403)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Access-Control-Allow-Origin", origin)
+                self.send_header("Access-Control-Allow-Methods",
+                                 ", ".join(rule["methods"]))
+                if rule["headers"]:
+                    self.send_header("Access-Control-Allow-Headers",
+                                     ", ".join(rule["headers"]))
+                if rule["max_age"]:
+                    self.send_header("Access-Control-Max-Age",
+                                     rule["max_age"])
+                self.send_header("Content-Length", "0")
+                self.end_headers()
 
             do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _handle
 

@@ -1,0 +1,295 @@
+"""Extended S3 API surface: conditional requests, UploadPartCopy,
+CORS config + preflight, SigV2 legacy auth, mime defaults (ref
+cmd/object-handlers-common.go checkPreconditions, CopyObjectPartHandler,
+cmd/signature-v2.go, pkg/mimedb)."""
+
+import http.client
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from minio_tpu.erasure.engine import ErasureObjects
+from minio_tpu.s3 import sigv4
+from minio_tpu.s3.client import S3Client
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.xl import XLStorage
+
+ACCESS, SECRET = "extadmin", "extadmin-secret"
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    root = tmp_path_factory.mktemp("extdisks")
+    disks = [XLStorage(str(root / f"d{i}")) for i in range(4)]
+    srv = S3Server(ErasureObjects(disks, block_size=64 * 1024),
+                   ACCESS, SECRET)
+    port = srv.start()
+    yield srv, port
+    srv.stop()
+
+
+@pytest.fixture
+def client(server):
+    _, port = server
+    return S3Client("127.0.0.1", port, ACCESS, SECRET)
+
+
+# ---------------------------------------------------------------------------
+# conditional requests
+# ---------------------------------------------------------------------------
+
+
+def test_conditional_get(client):
+    client.make_bucket("condb")
+    r = client.put_object("condb", "c.txt", b"conditional")
+    etag = r.headers["etag"].strip('"')
+    # If-None-Match with the live ETag -> 304, no body.
+    r = client.get_object("condb", "c.txt",
+                          headers={"if-none-match": f'"{etag}"'})
+    assert r.status == 304 and r.body == b""
+    # If-None-Match with a different tag -> 200.
+    r = client.get_object("condb", "c.txt",
+                          headers={"if-none-match": '"deadbeef"'})
+    assert r.status == 200
+    # If-Match mismatch -> 412.
+    r = client.get_object("condb", "c.txt",
+                          headers={"if-match": '"deadbeef"'})
+    assert r.status == 412
+    assert b"PreconditionFailed" in r.body
+    # If-Match hit -> 200.
+    r = client.get_object("condb", "c.txt",
+                          headers={"if-match": f'"{etag}"'})
+    assert r.status == 200
+    # If-Modified-Since in the future -> 304.
+    r = client.get_object("condb", "c.txt", headers={
+        "if-modified-since": "Thu, 01 Jan 2037 00:00:00 GMT"})
+    assert r.status == 304
+    # If-Unmodified-Since in the past -> 412.
+    r = client.get_object("condb", "c.txt", headers={
+        "if-unmodified-since": "Thu, 01 Jan 2004 00:00:00 GMT"})
+    assert r.status == 412
+
+
+def test_conditional_copy_source(client):
+    client.make_bucket("condcopy")
+    client.put_object("condcopy", "src", b"copy source")
+    r = client.request("PUT", "/condcopy/dst", headers={
+        "x-amz-copy-source": "/condcopy/src",
+        "x-amz-copy-source-if-match": '"wrong-etag"'})
+    assert r.status == 412
+    assert client.get_object("condcopy", "dst").status == 404
+
+
+# ---------------------------------------------------------------------------
+# UploadPartCopy
+# ---------------------------------------------------------------------------
+
+
+def test_upload_part_copy(client):
+    client.make_bucket("partcopy")
+    src = bytes(range(256)) * 40000  # ~10MB source
+    client.put_object("partcopy", "src.bin", src)
+    r = client.request("POST", "/partcopy/assembled.bin",
+                       query="uploads")
+    upload_id = ET.fromstring(r.body).findtext(
+        "{http://s3.amazonaws.com/doc/2006-03-01/}UploadId")
+    # Part 1: first 5MiB of the source via range copy.
+    five = 5 * 1024 * 1024
+    r = client.request(
+        "PUT", "/partcopy/assembled.bin",
+        query=f"partNumber=1&uploadId={upload_id}",
+        headers={"x-amz-copy-source": "/partcopy/src.bin",
+                 "x-amz-copy-source-range": f"bytes=0-{five - 1}"})
+    assert r.status == 200, r.body
+    assert b"CopyPartResult" in r.body
+    etag1 = ET.fromstring(r.body).findtext(
+        "{http://s3.amazonaws.com/doc/2006-03-01/}ETag").strip('"')
+    # Part 2: whole-source copy (no range).
+    r = client.request(
+        "PUT", "/partcopy/assembled.bin",
+        query=f"partNumber=2&uploadId={upload_id}",
+        headers={"x-amz-copy-source": "/partcopy/src.bin"})
+    assert r.status == 200
+    etag2 = ET.fromstring(r.body).findtext(
+        "{http://s3.amazonaws.com/doc/2006-03-01/}ETag").strip('"')
+    doc = ("<CompleteMultipartUpload>"
+           f"<Part><PartNumber>1</PartNumber><ETag>\"{etag1}\"</ETag>"
+           "</Part>"
+           f"<Part><PartNumber>2</PartNumber><ETag>\"{etag2}\"</ETag>"
+           "</Part></CompleteMultipartUpload>")
+    r = client.request("POST", "/partcopy/assembled.bin",
+                       query=f"uploadId={upload_id}",
+                       body=doc.encode())
+    assert r.status == 200, r.body
+    g = client.get_object("partcopy", "assembled.bin")
+    assert g.body == src[:five] + src
+
+
+# ---------------------------------------------------------------------------
+# CORS
+# ---------------------------------------------------------------------------
+
+CORS_XML = (b"<CORSConfiguration><CORSRule>"
+            b"<AllowedOrigin>https://app.example.com</AllowedOrigin>"
+            b"<AllowedOrigin>https://*.trusted.io</AllowedOrigin>"
+            b"<AllowedMethod>GET</AllowedMethod>"
+            b"<AllowedMethod>PUT</AllowedMethod>"
+            b"<AllowedHeader>content-type</AllowedHeader>"
+            b"<ExposeHeader>ETag</ExposeHeader>"
+            b"<MaxAgeSeconds>600</MaxAgeSeconds>"
+            b"</CORSRule></CORSConfiguration>")
+
+
+def _preflight(port, path, origin, method):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request("OPTIONS", path, headers={
+            "Origin": origin,
+            "Access-Control-Request-Method": method})
+        r = conn.getresponse()
+        return r.status, {k.lower(): v for k, v in r.getheaders()}, \
+            r.read()
+    finally:
+        conn.close()
+
+
+def test_cors_config_and_preflight(server, client):
+    _, port = server
+    client.make_bucket("corsb")
+    assert client.request("PUT", "/corsb", query="cors",
+                          body=CORS_XML).status == 200
+    r = client.request("GET", "/corsb", query="cors")
+    assert r.status == 200 and b"CORSRule" in r.body
+
+    status, headers, _ = _preflight(port, "/corsb/k",
+                                    "https://app.example.com", "PUT")
+    assert status == 200
+    assert headers["access-control-allow-origin"] == \
+        "https://app.example.com"
+    assert "PUT" in headers["access-control-allow-methods"]
+    assert headers["access-control-max-age"] == "600"
+    # Wildcard origin pattern.
+    status, _, _ = _preflight(port, "/corsb/k",
+                              "https://cdn.trusted.io", "GET")
+    assert status == 200
+    # Disallowed origin / method -> 403.
+    status, _, _ = _preflight(port, "/corsb/k",
+                              "https://evil.example.net", "GET")
+    assert status == 403
+    status, _, _ = _preflight(port, "/corsb/k",
+                              "https://app.example.com", "DELETE")
+    assert status == 403
+
+    # Actual response carries the allow/expose headers for a matching
+    # Origin.
+    client.put_object("corsb", "o.txt", b"cors body")
+    r = client.get_object("corsb", "o.txt",
+                          headers={"origin": "https://app.example.com"})
+    assert r.headers.get("access-control-allow-origin") == \
+        "https://app.example.com"
+    assert "ETag" in r.headers.get("access-control-expose-headers", "")
+    # DELETE of the config turns preflight off.
+    assert client.request("DELETE", "/corsb",
+                          query="cors").status == 204
+    status, _, _ = _preflight(port, "/corsb/k",
+                              "https://app.example.com", "PUT")
+    assert status == 403
+
+
+# ---------------------------------------------------------------------------
+# SigV2
+# ---------------------------------------------------------------------------
+
+
+def _v2_request(port, method, path, query="", body=b""):
+    headers = sigv4.sign_request_v2(
+        method, path, query, {"host": f"127.0.0.1:{port}"},
+        ACCESS, SECRET)
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        url = path + (f"?{query}" if query else "")
+        conn.request(method, url, body=body, headers=headers)
+        r = conn.getresponse()
+        return r.status, r.read()
+    finally:
+        conn.close()
+
+
+def test_sigv2_roundtrip(server, client):
+    _, port = server
+    status, _ = _v2_request(port, "PUT", "/v2bucket")
+    assert status == 200
+    status, _ = _v2_request(port, "PUT", "/v2bucket/legacy.txt",
+                            body=b"v2 signed")
+    assert status == 200
+    status, body = _v2_request(port, "GET", "/v2bucket/legacy.txt")
+    assert status == 200 and body == b"v2 signed"
+    # Wrong secret -> 403.
+    headers = sigv4.sign_request_v2(
+        "GET", "/v2bucket/legacy.txt", "",
+        {"host": f"127.0.0.1:{port}"}, ACCESS, "bad-secret")
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("GET", "/v2bucket/legacy.txt", headers=headers)
+    assert conn.getresponse().status == 403
+    conn.close()
+
+
+# ---------------------------------------------------------------------------
+# mime defaults
+# ---------------------------------------------------------------------------
+
+
+def test_mime_default_from_extension(client):
+    client.make_bucket("mimeb")
+    client.put_object("mimeb", "page.html", b"<html/>")
+    r = client.head_object("mimeb", "page.html")
+    assert r.headers["content-type"] == "text/html"
+    client.put_object("mimeb", "noext", b"x")
+    r = client.head_object("mimeb", "noext")
+    assert r.headers["content-type"] == "application/octet-stream"
+    # Explicit content-type always wins.
+    client.put_object("mimeb", "data.html", b"x",
+                      headers={"content-type": "application/json"})
+    assert client.head_object("mimeb", "data.html").headers[
+        "content-type"] == "application/json"
+
+
+def test_preflight_header_restriction(server, client):
+    _, port = server
+    client.make_bucket("corshdr")
+    client.request("PUT", "/corshdr", query="cors", body=CORS_XML)
+    # Requesting a header outside AllowedHeader -> 403.
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("OPTIONS", "/corshdr/k", headers={
+        "Origin": "https://app.example.com",
+        "Access-Control-Request-Method": "PUT",
+        "Access-Control-Request-Headers": "x-custom-auth"})
+    assert conn.getresponse().status == 403
+    conn.close()
+    # An allowed header passes.
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("OPTIONS", "/corshdr/k", headers={
+        "Origin": "https://app.example.com",
+        "Access-Control-Request-Method": "PUT",
+        "Access-Control-Request-Headers": "content-type"})
+    assert conn.getresponse().status == 200
+    conn.close()
+
+
+def test_part_copy_respects_quota(server, client):
+    import json as _json
+    import time as _time
+    client.make_bucket("pcquota")
+    client.put_object("pcquota", "big", b"Q" * 30_000)
+    r = client.request("POST", "/minio-tpu/admin/v1/set-bucket-quota",
+                       query="bucket=pcquota",
+                       body=_json.dumps({"quota": 40_000}).encode())
+    assert r.status == 200
+    _time.sleep(2.1)  # usage cache TTL
+    r = client.request("POST", "/pcquota/mp", query="uploads")
+    upload_id = ET.fromstring(r.body).findtext(
+        "{http://s3.amazonaws.com/doc/2006-03-01/}UploadId")
+    r = client.request("PUT", "/pcquota/mp",
+                       query=f"partNumber=1&uploadId={upload_id}",
+                       headers={"x-amz-copy-source": "/pcquota/big"})
+    assert r.status == 409  # 30k existing + 30k copy > 40k quota
