@@ -255,7 +255,8 @@ def _serve(args) -> int:
     from .scanner.crawler import DataCrawler
     crawler = DataCrawler(
         layer, server.bucket_meta, notifier=server.notifier,
-        interval=float(os.environ.get("MINIO_CRAWLER_INTERVAL", "60")))
+        interval=float(os.environ.get("MINIO_CRAWLER_INTERVAL", "60")),
+        tiers=server.handlers.tiers)
     crawler.start()
     server.crawler = crawler
 

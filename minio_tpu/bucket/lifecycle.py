@@ -20,6 +20,7 @@ NONE = "none"
 DELETE = "delete"                  # expire current version
 DELETE_VERSION = "delete-version"  # expire a noncurrent version
 DELETE_MARKER = "delete-marker"    # remove an expired delete marker
+TRANSITION = "transition"          # move current version to a tier
 
 _DAY = 24 * 3600.0
 
@@ -34,6 +35,9 @@ class Rule:
     expiration_date: float = 0.0
     expired_object_delete_marker: bool = False
     noncurrent_days: int = 0
+    transition_days: int = 0
+    transition_date: float = 0.0
+    transition_tier: str = ""      # <Transition><StorageClass>
 
     def enabled(self) -> bool:
         return self.status == "Enabled"
@@ -106,6 +110,15 @@ class Lifecycle:
                         exp.findtext("Date"))
                 if exp.findtext("ExpiredObjectDeleteMarker") == "true":
                     rule.expired_object_delete_marker = True
+            tr = r.find("Transition")
+            if tr is not None:
+                if tr.findtext("Days"):
+                    rule.transition_days = int(tr.findtext("Days"))
+                if tr.findtext("Date"):
+                    rule.transition_date = _parse_date(
+                        tr.findtext("Date"))
+                rule.transition_tier = (
+                    tr.findtext("StorageClass") or "").upper()
             nce = r.find("NoncurrentVersionExpiration")
             if nce is not None and nce.findtext("NoncurrentDays"):
                 rule.noncurrent_days = int(
@@ -119,6 +132,18 @@ class Lifecycle:
                        tags: dict | None = None,
                        sole_version: bool = True,
                        now: float | None = None) -> str:
+        return self.compute_with_tier(
+            name, mod_time, is_latest=is_latest,
+            delete_marker=delete_marker, tags=tags,
+            sole_version=sole_version, now=now)[0]
+
+    def compute_with_tier(self, name: str, mod_time: float,
+                          is_latest: bool = True,
+                          delete_marker: bool = False,
+                          tags: dict | None = None,
+                          sole_version: bool = True,
+                          now: float | None = None,
+                          ) -> tuple[str, str]:
         """Decide this version's fate (ref Lifecycle.ComputeAction).
         mod_time for a noncurrent version is WHEN IT BECAME noncurrent
         in the reference (successor mod-time); the caller passes the
@@ -131,17 +156,27 @@ class Lifecycle:
             if not is_latest:
                 if rule.noncurrent_days and \
                         now >= mod_time + rule.noncurrent_days * _DAY:
-                    return DELETE_VERSION
+                    return DELETE_VERSION, ""
                 continue
             if delete_marker:
                 # A delete marker with no remaining data versions is
                 # removable once flagged (ref ExpiredObjectDeleteMarker).
                 if rule.expired_object_delete_marker and sole_version:
-                    return DELETE_MARKER
+                    return DELETE_MARKER, ""
                 continue
             if rule.expiration_date and now >= rule.expiration_date:
-                return DELETE
+                return DELETE, ""
             if rule.expiration_days and \
                     now >= mod_time + rule.expiration_days * _DAY:
-                return DELETE
-        return NONE
+                return DELETE, ""
+            if rule.transition_tier:
+                due = ((rule.transition_date
+                        and now >= rule.transition_date)
+                       or (rule.transition_days
+                           and now >= mod_time
+                           + rule.transition_days * _DAY)
+                       or (not rule.transition_days
+                           and not rule.transition_date))
+                if due:
+                    return TRANSITION, rule.transition_tier
+        return NONE, ""

@@ -441,6 +441,34 @@ class AdminHandlers:
                 "rxRateBps": 0.0, "txRateBps": 0.0})}
         return {"buckets": report, "windowSeconds": 60}
 
+    # -- remote tiers (ref admin tier APIs, cmd/tier.go) ---------------
+
+    def _tiers(self):
+        return self.server.handlers.tiers
+
+    def h_add_tier(self, p, body):
+        from ..bucket.tiering import TierError
+        doc = json.loads(body)
+        try:
+            self._tiers().add(doc["name"], doc["endpoint"],
+                              doc["bucket"], doc["access_key"],
+                              doc["secret_key"],
+                              doc.get("prefix", ""))
+        except TierError as e:
+            raise ValueError(str(e))
+        return {"ok": True}
+
+    def h_list_tiers(self, p, body):
+        return {"tiers": self._tiers().list()}
+
+    def h_remove_tier(self, p, body):
+        from ..bucket.tiering import TierError
+        try:
+            self._tiers().remove(p["name"], layer=self.server.layer)
+        except TierError as e:
+            raise ValueError(str(e))
+        return {"ok": True}
+
     # -- disk cache ----------------------------------------------------
 
     def h_cache_stats(self, p, body):

@@ -163,6 +163,8 @@ class S3ApiHandlers:
         from ..bucket.replication import ReplicationPool
         self.replication = ReplicationPool(
             self.bucket_meta, self.read_for_replication, layer)
+        from ..bucket.tiering import TierManager
+        self.tiers = TierManager(self.bucket_meta.store)
         from ..config.storageclass import StorageClassConfig
         self.storage_class = StorageClassConfig.from_env()
         self._usage_cache: dict[str, tuple[float, int]] = {}
@@ -225,6 +227,10 @@ class S3ApiHandlers:
         mode = sse.is_encrypted(info.metadata)
         if mode == sse.SSE_C:
             raise ValueError("SSE-C objects cannot be replicated")
+        from ..bucket import tiering as tier_mod
+        if tier_mod.needs_tier_read(info.metadata):
+            fake = S3Request("GET", f"/{bucket}", "", {}, b"")
+            return self._transitioned_plain(fake, info), info
         if mode:
             okey = sse.unseal_key(self.kms.master,
                                   info.metadata[sse.META_SEALED_KEY],
@@ -614,9 +620,38 @@ class S3ApiHandlers:
 
     @staticmethod
     def _actual_size(info: ObjectInfo) -> int:
+        from ..bucket import tiering
         from ..crypto import sse
         raw = info.metadata.get(sse.META_ACTUAL_SIZE)
-        return int(raw) if raw is not None else info.size
+        if raw is not None:
+            return int(raw)
+        tsize = info.metadata.get(tiering.META_TRANSITION_SIZE)
+        if tsize is not None and info.size == 0:
+            return int(tsize)  # stub: logical size lives in metadata
+        return info.size
+
+    def _transitioned_plain(self, req: S3Request, info: ObjectInfo,
+                            okey: bytes | None = None,
+                            okey_known: bool = False) -> bytes:
+        """Full plaintext of a transitioned object, streamed back from
+        its tier (ref the transitioned-object read path of
+        GetObjectNInfo, cmd/bucket-lifecycle.go). Raises
+        tiering.TierError when the tier is unreachable/removed."""
+        from ..bucket import tiering
+        from ..crypto import sse
+        from ..utils import compress
+        raw = self.tiers.read(info.metadata)
+        if not okey_known:
+            okey = self._sse_unseal_for_read(req, info)
+        if okey is not None:
+            def read_fn(off, ln):
+                if off is None:
+                    return len(raw)
+                return raw[off:off + ln]
+            raw = sse.decrypt_range(read_fn, okey, 0, len(raw))
+        if info.metadata.get(compress.META_COMPRESSION):
+            raw = compress.decompress_stream(raw)
+        return raw
 
     def _sse_decrypt_read(self, version_id: str, info: ObjectInfo,
                           okey: bytes, offset: int,
@@ -739,12 +774,19 @@ class S3ApiHandlers:
         # The copy re-evaluates encryption/compression for the
         # destination; the source's envelope must never leak across.
         from ..bucket import objectlock as ol
+        from ..bucket import tiering as tier_mod
         from ..bucket.replication import META_REPLICATION_STATUS
         for k in (sse.META_ALGORITHM, sse.META_SEALED_KEY,
                   sse.META_KEY_MD5, sse.META_KMS_KEY_ID,
                   sse.META_ACTUAL_SIZE, compress.META_COMPRESSION,
                   META_REPLICATION_STATUS, ol.META_MODE,
-                  ol.META_RETAIN_UNTIL, ol.META_LEGAL_HOLD, "etag"):
+                  ol.META_RETAIN_UNTIL, ol.META_LEGAL_HOLD,
+                  tier_mod.META_TRANSITION_TIER,
+                  tier_mod.META_TRANSITION_KEY,
+                  tier_mod.META_TRANSITION_SIZE,
+                  tier_mod.META_TRANSITION_ETAG,
+                  tier_mod.META_RESTORE, tier_mod.META_RESTORE_EXPIRY,
+                  "etag"):
             meta.pop(k, None)
         self._apply_lock_headers(req, meta)
         self._check_quota(req.bucket, len(data))
@@ -775,6 +817,12 @@ class S3ApiHandlers:
         bucket = req.bucket if bucket is None else bucket
         key = req.key if key is None else key
         info = self.layer.get_object_info(bucket, key, version_id)
+        from ..bucket import tiering as tier_mod
+        if tier_mod.needs_tier_read(info.metadata):
+            try:
+                return self._transitioned_plain(req, info), info
+            except tier_mod.TierError as e:
+                raise s3err.APIError("XMinioTierError", str(e), 503)
         okey = self._sse_unseal_for_read(req, info,
                                          copy_source=copy_source)
         if okey is not None:
@@ -835,7 +883,16 @@ class S3ApiHandlers:
                 raise s3err.ERR_PRECONDITION_FAILED
             rng = _parse_range(req.headers.get("range", ""), size)
             data = b""
-            if not head:
+            from ..bucket import tiering as tier_mod
+            if not head and tier_mod.needs_tier_read(info.metadata):
+                try:
+                    plain = self._transitioned_plain(
+                        req, info, okey=okey, okey_known=True)
+                except tier_mod.TierError as e:
+                    raise s3err.APIError("XMinioTierError", str(e), 503)
+                data = (plain if rng is None
+                        else plain[rng[0]:rng[0] + rng[1]])
+            elif not head:
                 if comp:
                     # SSE's inner plaintext IS the compressed stream;
                     # its length <= stored size, so that bound reads all.
@@ -1664,11 +1721,52 @@ class S3ApiHandlers:
             return S3Response(201, root.tobytes(), h)
         return S3Response(200 if status == "200" else 204, b"", h)
 
+    def restore_object(self, req: S3Request) -> S3Response:
+        """POST /bucket/key?restore (ref PostRestoreObjectHandler,
+        cmd/bucket-lifecycle.go RestoreTransitionedObject)."""
+        from ..bucket import tiering
+        days = 1
+        if req.body:
+            try:
+                doc = parse(req.body)
+                days = int(doc.findtext("Days") or "1")
+            except Exception:
+                raise s3err.ERR_MALFORMED_XML
+        try:
+            tiering.restore_object(self.layer, self.tiers, req.bucket,
+                                   req.key, days)
+        except (ObjectNotFound, BucketNotFound):
+            raise s3err.ERR_NO_SUCH_KEY
+        except tiering.TierError as e:
+            raise s3err.APIError("InvalidObjectState", str(e), 403)
+        return S3Response(202)
+
+    def _tier_meta_if_destroying(self, bucket: str, key: str,
+                                 version_id: str,
+                                 versioned: bool) -> dict | None:
+        """Metadata of a transitioned object about to be DESTROYED
+        (unversioned delete or versioned delete of the data version) —
+        its remote copy must be GC'd (ref deleteTransitionedObject)."""
+        from ..bucket import tiering as tier_mod
+        if not self.tiers.list():
+            return None
+        if versioned and not version_id:
+            return None  # marker write: data survives
+        try:
+            info = self.layer.get_object_info(bucket, key, version_id)
+        except Exception:
+            return None
+        return (info.metadata
+                if tier_mod.is_transitioned(info.metadata) else None)
+
     def delete_object(self, req: S3Request) -> S3Response:
         version_id = self._version_param(req)
         self._check_version_delete_allowed(
             req.bucket, req.key, version_id,
             self._can_bypass_governance(req))
+        tier_meta = self._tier_meta_if_destroying(
+            req.bucket, req.key, version_id,
+            self._versioned(req.bucket))
         h = {}
         try:
             deleted = self.layer.delete_object(
@@ -1690,6 +1788,8 @@ class S3ApiHandlers:
                                                         req.key):
                 self.replication.queue_task(req.bucket, req.key, "",
                                             "delete")
+            if tier_meta is not None and not deleted.delete_marker:
+                self.tiers.delete_remote(tier_meta)
         except (ObjectNotFound, BucketNotFound):
             if version_id:  # S3 DELETE is idempotent-success on missing keys
                 h["x-amz-version-id"] = version_id
@@ -1936,6 +2036,8 @@ class S3Server:
             if m == "GET":
                 return "s3:ListMultipartUploadParts", resource
             return "s3:PutObject", resource
+        if m == "POST" and "restore" in p:
+            return "s3:RestoreObject", resource
         if m == "POST" and "select" in p:
             # SELECT scans object content: same grant as GetObject
             # (ref SelectObjectContentHandler auth).
@@ -2079,6 +2181,8 @@ class S3Server:
             return h.object_retention(req)
         if "legal-hold" in p:
             return h.object_legal_hold(req)
+        if m == "POST" and "restore" in p:
+            return h.restore_object(req)
         if m == "POST" and "select" in p:
             return h.select_object_content(req)
         if m == "POST" and "uploads" in p:

@@ -21,6 +21,7 @@ import threading
 import time
 
 from ..bucket.lifecycle import (DELETE, DELETE_MARKER, DELETE_VERSION,
+                                TRANSITION,
                                 Lifecycle, parse_tags)
 from ..erasure.engine import MethodNotAllowed, ObjectNotFound
 
@@ -46,14 +47,17 @@ def _bucket_for_size(size: int) -> str:
 
 class DataCrawler:
     def __init__(self, layer, bucket_meta, store=None, notifier=None,
-                 interval: float = 60.0, heal_sample: int = 512):
+                 interval: float = 60.0, heal_sample: int = 512,
+                 tiers=None):
         """layer: ObjectLayer; bucket_meta: BucketMetadataSys; store:
         ConfigStore for persistence (defaults to bucket_meta's);
-        heal_sample: sample 1-in-N objects for deep verification."""
+        heal_sample: sample 1-in-N objects for deep verification;
+        tiers: TierManager enabling ILM transition."""
         self.layer = layer
         self.bucket_meta = bucket_meta
         self.store = store if store is not None else bucket_meta.store
         self.notifier = notifier
+        self.tiers = tiers
         self.interval = interval
         self.heal_sample = max(1, heal_sample)
         self._counter = 0
@@ -189,23 +193,39 @@ class DataCrawler:
             is_latest = i == 0
             noncurrent_since = vers[i - 1].mod_time if i > 0 else v.mod_time
             tags = parse_tags(v.metadata.get("x-amz-tagging", ""))
-            action = lc.compute_action(
+            action, tier = lc.compute_with_tier(
                 key, noncurrent_since if not is_latest else v.mod_time,
                 is_latest=is_latest, delete_marker=v.delete_marker,
                 tags=tags, sole_version=len(vers) == 1, now=now)
             try:
-                if action == DELETE:
+                from ..bucket import tiering as tier_mod
+                if (self.tiers is not None and is_latest
+                        and tier_mod.restub_if_restore_expired(
+                            self.layer, bucket, key, v.metadata, now)):
+                    pass  # expired restore collapsed back to a stub
+                if action == TRANSITION:
+                    if self.tiers is not None and is_latest:
+                        tier_mod.transition_object(
+                            self.layer, self.tiers, bucket, key, tier,
+                            versioned=versioned)
+                elif action == DELETE:
                     # Expire the current version: versioned buckets get
                     # a delete marker, unversioned delete outright.
                     out = self.layer.delete_object(bucket, key,
                                                    versioned=versioned)
                     v._expired = not versioned
                     self._notify_removed(bucket, key, out)
+                    if (not versioned and self.tiers is not None
+                            and tier_mod.is_transitioned(v.metadata)):
+                        self.tiers.delete_remote(v.metadata)
                 elif action in (DELETE_VERSION, DELETE_MARKER):
                     out = self.layer.delete_object(bucket, key,
                                                    v.version_id or "")
                     v._expired = True
                     self._notify_removed(bucket, key, out)
+                    if (self.tiers is not None
+                            and tier_mod.is_transitioned(v.metadata)):
+                        self.tiers.delete_remote(v.metadata)
             except ObjectNotFound:
                 pass
             except Exception:

@@ -1,0 +1,240 @@
+"""ILM tiering: tier registry, lifecycle transition, transparent
+tiered reads, RestoreObject (ref cmd/tier.go, cmd/bucket-lifecycle.go
+transition flow)."""
+
+import json
+import time
+
+import pytest
+
+from minio_tpu.bucket import tiering
+from minio_tpu.bucket.lifecycle import TRANSITION, Lifecycle
+from minio_tpu.erasure.engine import ErasureObjects
+from minio_tpu.s3.client import S3Client
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.xl import XLStorage
+
+ACCESS, SECRET = "tieradm", "tieradm-secret"
+
+
+@pytest.fixture
+def stack(tmp_path):
+    """Primary server + a second server acting as the remote tier."""
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    srv = S3Server(ErasureObjects(disks, block_size=64 * 1024),
+                   ACCESS, SECRET)
+    port = srv.start()
+    tdisks = [XLStorage(str(tmp_path / f"t{i}")) for i in range(4)]
+    tier_srv = S3Server(ErasureObjects(tdisks, block_size=64 * 1024),
+                        ACCESS, SECRET)
+    tier_port = tier_srv.start()
+    c = S3Client("127.0.0.1", port, ACCESS, SECRET)
+    tc = S3Client("127.0.0.1", tier_port, ACCESS, SECRET)
+    tc.make_bucket("coldstore")
+    yield srv, c, tier_srv, tc, port, tier_port
+    srv.stop()
+    tier_srv.stop()
+
+
+def _add_tier(c, tier_port, name="GLACIER"):
+    r = c.request("POST", "/minio-tpu/admin/v1/add-tier",
+                  body=json.dumps({
+                      "name": name,
+                      "endpoint": f"127.0.0.1:{tier_port}",
+                      "bucket": "coldstore",
+                      "access_key": ACCESS, "secret_key": SECRET,
+                      "prefix": "tiered"}).encode())
+    assert r.status == 200, r.body
+    return name
+
+
+def test_tier_admin_registry(stack):
+    _, c, _, _, _, tier_port = stack
+    _add_tier(c, tier_port)
+    r = c.request("GET", "/minio-tpu/admin/v1/list-tiers")
+    tiers = json.loads(r.body)["tiers"]
+    assert [t["name"] for t in tiers] == ["GLACIER"]
+    assert all("secret_key" not in t for t in tiers)
+    # Duplicate name rejected.
+    r = c.request("POST", "/minio-tpu/admin/v1/add-tier",
+                  body=json.dumps({
+                      "name": "glacier",
+                      "endpoint": f"127.0.0.1:{tier_port}",
+                      "bucket": "x", "access_key": "a",
+                      "secret_key": "b"}).encode())
+    assert r.status == 400
+    r = c.request("POST", "/minio-tpu/admin/v1/remove-tier",
+                  query="name=GLACIER")
+    assert r.status == 200
+    assert json.loads(c.request(
+        "GET", "/minio-tpu/admin/v1/list-tiers").body)["tiers"] == []
+
+
+def test_transition_and_read_through(stack):
+    srv, c, _, tc, _, tier_port = stack
+    _add_tier(c, tier_port)
+    c.make_bucket("hotb")
+    payload = bytes(range(256)) * 300
+    c.put_object("hotb", "cold.bin", payload,
+                 headers={"x-amz-meta-team": "archive"})
+    assert tiering.transition_object(srv.layer, srv.handlers.tiers,
+                                     "hotb", "cold.bin", "GLACIER")
+    # Local stub is tiny; logical object unchanged through the API.
+    info = srv.layer.get_object_info("hotb", "cold.bin")
+    assert info.size == 0
+    assert tiering.is_transitioned(info.metadata)
+    h = c.head_object("hotb", "cold.bin")
+    assert h.status == 200
+    assert h.headers["content-length"] == str(len(payload))
+    g = c.get_object("hotb", "cold.bin")
+    assert g.status == 200 and g.body == payload
+    assert g.headers.get("x-amz-meta-team") == "archive"
+    # Range reads slice the tiered bytes.
+    r = c.get_object("hotb", "cold.bin",
+                     headers={"range": "bytes=256-511"})
+    assert r.status == 206 and r.body == bytes(range(256))
+    # The bytes physically live on the tier bucket.
+    listed = tc.list_objects_v2("coldstore", prefix="tiered/")
+    assert b"hotb/cold.bin" in listed.body
+    # Listing reports the tier as storage class.
+    ls = c.list_objects_v2("hotb")
+    assert b"GLACIER" in ls.body
+    # Second transition attempt is a no-op.
+    assert not tiering.transition_object(
+        srv.layer, srv.handlers.tiers, "hotb", "cold.bin", "GLACIER")
+
+
+def test_restore_object(stack):
+    srv, c, _, _tc, _, tier_port = stack
+    _add_tier(c, tier_port)
+    c.make_bucket("restb")
+    payload = b"restore me" * 1000
+    c.put_object("restb", "r.bin", payload)
+    tiering.transition_object(srv.layer, srv.handlers.tiers,
+                              "restb", "r.bin", "GLACIER")
+    r = c.request("POST", "/restb/r.bin", query="restore",
+                  body=b"<RestoreRequest><Days>2</Days></RestoreRequest>")
+    assert r.status == 202, r.body
+    info = srv.layer.get_object_info("restb", "r.bin")
+    # The tier pointer stays (expiry re-stubs later) but reads serve
+    # the restored LOCAL copy.
+    assert tiering.is_transitioned(info.metadata)
+    assert tiering.restore_active(info.metadata)
+    assert not tiering.needs_tier_read(info.metadata)
+    assert info.size == len(payload)
+    assert "x-amz-restore" in info.metadata
+    assert c.get_object("restb", "r.bin").body == payload
+    # After expiry the crawler collapses it back to a stub; the data
+    # still reads through from the tier.
+    srv.layer.update_object_metadata(
+        "restb", "r.bin",
+        {tiering.META_RESTORE_EXPIRY: str(time.time() - 10)})
+    meta = srv.layer.get_object_info("restb", "r.bin").metadata
+    assert tiering.restub_if_restore_expired(srv.layer, "restb",
+                                             "r.bin", meta)
+    info = srv.layer.get_object_info("restb", "r.bin")
+    assert info.size == 0 and tiering.needs_tier_read(info.metadata)
+    assert c.get_object("restb", "r.bin").body == payload
+    # A plain (never-transitioned) object -> 403 InvalidObjectState.
+    c.put_object("restb", "plain.bin", b"p")
+    r = c.request("POST", "/restb/plain.bin", query="restore", body=b"")
+    assert r.status == 403
+
+
+def test_delete_gcs_remote_copy(stack):
+    srv, c, _, tc, _, tier_port = stack
+    _add_tier(c, tier_port)
+    c.make_bucket("gcb")
+    c.put_object("gcb", "tmp.bin", b"G" * 3000)
+    tiering.transition_object(srv.layer, srv.handlers.tiers,
+                              "gcb", "tmp.bin", "GLACIER")
+    assert b"gcb/tmp.bin" in tc.list_objects_v2(
+        "coldstore", prefix="tiered/").body
+    assert c.delete_object("gcb", "tmp.bin").status == 204
+    # The remote tier copy went with it.
+    assert b"gcb/tmp.bin" not in tc.list_objects_v2(
+        "coldstore", prefix="tiered/").body
+
+
+def test_remove_tier_in_use_refused(stack):
+    srv, c, _, _tc, _, tier_port = stack
+    _add_tier(c, tier_port)
+    c.make_bucket("useb")
+    c.put_object("useb", "pinned", b"x" * 2000)
+    tiering.transition_object(srv.layer, srv.handlers.tiers,
+                              "useb", "pinned", "GLACIER")
+    r = c.request("POST", "/minio-tpu/admin/v1/remove-tier",
+                  query="name=GLACIER")
+    assert r.status == 400
+    assert b"in use" in r.body
+    # After the object is gone, removal succeeds.
+    c.delete_object("useb", "pinned")
+    r = c.request("POST", "/minio-tpu/admin/v1/remove-tier",
+                  query="name=GLACIER")
+    assert r.status == 200
+
+
+def test_crawler_drives_transition(stack, tmp_path):
+    srv, c, _, tc, _, tier_port = stack
+    _add_tier(c, tier_port)
+    c.make_bucket("ilmtier")
+    c.put_object("ilmtier", "old.log", b"L" * 5000)
+    # Transition after 1 day; backdate the object 2 days.
+    c.request("PUT", "/ilmtier", query="lifecycle",
+              body=b"<LifecycleConfiguration><Rule>"
+                   b"<ID>t</ID><Status>Enabled</Status><Prefix></Prefix>"
+                   b"<Transition><Days>1</Days>"
+                   b"<StorageClass>GLACIER</StorageClass></Transition>"
+                   b"</Rule></LifecycleConfiguration>")
+    from minio_tpu.scanner.crawler import DataCrawler
+    crawler = DataCrawler(srv.layer, srv.bucket_meta,
+                          tiers=srv.handlers.tiers, interval=3600)
+    # Backdate the stored mod_time so the 1-day rule is already due.
+    fi, agreed = srv.layer._quorum_file_info("ilmtier", "old.log")
+    for i, own in enumerate(agreed):
+        if own is not None:
+            own.mod_time -= 3 * 86400
+            srv.layer.disks[i].write_metadata("ilmtier", "old.log", own)
+    crawler.crawl_once()
+    info = srv.layer.get_object_info("ilmtier", "old.log")
+    assert tiering.is_transitioned(info.metadata), info.metadata
+    assert c.get_object("ilmtier", "old.log").body == b"L" * 5000
+
+
+def test_lifecycle_transition_parse():
+    lc = Lifecycle.parse(
+        "<LifecycleConfiguration><Rule><ID>a</ID>"
+        "<Status>Enabled</Status><Prefix>logs/</Prefix>"
+        "<Transition><Days>30</Days><StorageClass>COLD</StorageClass>"
+        "</Transition></Rule></LifecycleConfiguration>")
+    now = time.time()
+    action, tier = lc.compute_with_tier("logs/a", now - 31 * 86400,
+                                        now=now)
+    assert (action, tier) == (TRANSITION, "COLD")
+    action, _ = lc.compute_with_tier("logs/a", now - 86400, now=now)
+    assert action == "none"
+    action, _ = lc.compute_with_tier("other", now - 365 * 86400,
+                                     now=now)
+    assert action == "none"
+
+
+def test_sse_and_compression_survive_transition(stack, monkeypatch):
+    """Transitioned bytes are the STORED envelope: SSE-S3 + compression
+    still decrypt/decompress on read-through."""
+    srv, c, _, _tc, _, tier_port = stack
+    _add_tier(c, tier_port)
+    import os
+    monkeypatch.setenv("MINIO_KMS_SECRET_KEY", "tierkey:a2tra2tra2tra2tra2tra2tra2tra2tra2tra2tra2s=")
+    from minio_tpu.crypto.sse import LocalKMS
+    srv.handlers.kms = LocalKMS.from_env()
+    srv.handlers.compress_enabled = True
+    c.make_bucket("envb")
+    payload = b"compressible text " * 4096
+    r = c.put_object("envb", "sec.txt", payload,
+                     headers={"content-type": "text/plain",
+                              "x-amz-server-side-encryption": "AES256"})
+    assert r.status == 200, r.body
+    tiering.transition_object(srv.layer, srv.handlers.tiers,
+                              "envb", "sec.txt", "GLACIER")
+    g = c.get_object("envb", "sec.txt")
+    assert g.status == 200 and g.body == payload
