@@ -253,6 +253,31 @@ class IAMSys:
                             u.to_dict())
         return u
 
+    def assume_role_web_identity(self, subject: str, policy_name: str,
+                                 duration_seconds: int = 3600,
+                                 ) -> UserIdentity:
+        """Temp credentials for an EXTERNAL (OpenID) identity; the
+        token's policy claim names the canned policy to attach (ref
+        AssumeRoleWithWebIdentity, cmd/sts-handlers.go)."""
+        with self._mu:
+            if policy_name not in self.policies:
+                raise KeyError(f"no such policy {policy_name!r}")
+        duration_seconds = max(900, min(duration_seconds, 7 * 24 * 3600))
+        exp = time.time() + duration_seconds
+        tmp_access = "MTPU" + secrets.token_hex(8).upper()
+        tmp_secret = secrets.token_urlsafe(24)
+        token = self._sign_token({"sub": subject, "exp": exp,
+                                  "secret": tmp_secret})
+        u = UserIdentity(tmp_access, tmp_secret,
+                         policies=[policy_name],
+                         parent=f"oidc:{subject}",
+                         session_token=token, expiration=exp)
+        with self._mu:
+            self.users[tmp_access] = u
+            self.store.save(f"{IAM_PREFIX}/users/{tmp_access}.json",
+                            u.to_dict())
+        return u
+
     def _sign_token(self, claims: dict) -> str:
         body = base64.urlsafe_b64encode(
             json.dumps(claims, sort_keys=True).encode()).decode()

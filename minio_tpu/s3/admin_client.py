@@ -1,0 +1,187 @@
+"""Admin API client SDK — the `madmin` analog (ref pkg/madmin, 5856
+LoC: the Go client the reference's `mc admin` is built on). Wraps the
+SigV4 S3Client against the `/minio-tpu/admin/v1/*` JSON routes so
+tools and tests never hand-roll admin requests.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+
+from .client import S3Client
+
+
+class AdminError(Exception):
+    def __init__(self, status: int, body: bytes):
+        super().__init__(f"admin API {status}: {body[:200]!r}")
+        self.status = status
+        self.body = body
+
+
+class AdminClient:
+    def __init__(self, host: str, port: int, access_key: str,
+                 secret_key: str):
+        self._c = S3Client(host, port, access_key, secret_key)
+
+    def _call(self, method: str, route: str, params: dict | None = None,
+              body: bytes = b"") -> dict:
+        query = urllib.parse.urlencode(params or {})
+        r = self._c.request(method, f"/minio-tpu/admin/v1/{route}",
+                            query=query, body=body)
+        if r.status != 200:
+            raise AdminError(r.status, r.body)
+        return json.loads(r.body) if r.body else {}
+
+    # -- info / usage ---------------------------------------------------
+
+    def server_info(self) -> dict:
+        return self._call("GET", "info")
+
+    def data_usage(self) -> dict:
+        return self._call("GET", "datausage")
+
+    def obd_info(self, drive_perf: bool = False) -> dict:
+        return self._call("GET", "obd-info",
+                          {"drivePerf": "true"} if drive_perf else {})
+
+    # -- users / policies -----------------------------------------------
+
+    def add_user(self, access_key: str, secret_key: str,
+                 policies: list[str] | None = None) -> None:
+        self._call("POST", "add-user", body=json.dumps({
+            "accessKey": access_key, "secretKey": secret_key,
+            "policies": policies or []}).encode())
+
+    def list_users(self) -> list:
+        return self._call("GET", "list-users")["users"]
+
+    def remove_user(self, access_key: str) -> None:
+        self._call("POST", "remove-user", {"accessKey": access_key})
+
+    def add_policy(self, name: str, policy: dict) -> None:
+        self._call("POST", "add-policy", {"name": name},
+                   json.dumps(policy).encode())
+
+    def list_policies(self) -> list:
+        return self._call("GET", "list-policies")["policies"]
+
+    def set_user_policy(self, access_key: str,
+                        policies: list[str]) -> None:
+        self._call("POST", "set-user-policy",
+                   {"accessKey": access_key,
+                    "policies": ",".join(policies)})
+
+    # -- heal -----------------------------------------------------------
+
+    def heal(self, bucket: str = "", prefix: str = "",
+             dry_run: bool = False) -> list:
+        p = {}
+        if bucket:
+            p["bucket"] = bucket
+        if prefix:
+            p["prefix"] = prefix
+        if dry_run:
+            p["dryRun"] = "true"
+        return self._call("POST", "heal", p)["items"]
+
+    def heal_start(self, bucket: str = "", prefix: str = "") -> str:
+        p = {}
+        if bucket:
+            p["bucket"] = bucket
+        if prefix:
+            p["prefix"] = prefix
+        return self._call("POST", "heal-start", p)["clientToken"]
+
+    def heal_status(self, token: str) -> dict:
+        return self._call("GET", "heal-status", {"token": token})
+
+    # -- config ---------------------------------------------------------
+
+    def get_config(self) -> dict:
+        return self._call("GET", "get-config")["config"]
+
+    def set_config_kv(self, line: str) -> None:
+        self._call("POST", "set-config-kv", body=line.encode())
+
+    def del_config_kv(self, spec: str) -> None:
+        self._call("POST", "del-config-kv", body=spec.encode())
+
+    def config_history(self) -> list:
+        return self._call("GET", "config-history")["entries"]
+
+    def restore_config(self, history_id: str) -> None:
+        self._call("POST", "restore-config", {"id": history_id})
+
+    # -- quota / replication / tiers ------------------------------------
+
+    def set_bucket_quota(self, bucket: str, quota_bytes: int,
+                         quota_type: str = "hard") -> None:
+        body = b"{}" if not quota_bytes else json.dumps(
+            {"quota": quota_bytes, "quotaType": quota_type}).encode()
+        self._call("POST", "set-bucket-quota", {"bucket": bucket}, body)
+
+    def get_bucket_quota(self, bucket: str) -> dict:
+        return self._call("GET", "get-bucket-quota", {"bucket": bucket})
+
+    def set_remote_target(self, bucket: str, endpoint: str,
+                          target_bucket: str, access_key: str,
+                          secret_key: str) -> str:
+        return self._call("POST", "set-remote-target",
+                          {"bucket": bucket}, json.dumps({
+                              "endpoint": endpoint,
+                              "target_bucket": target_bucket,
+                              "access_key": access_key,
+                              "secret_key": secret_key}).encode())["arn"]
+
+    def list_remote_targets(self, bucket: str) -> list:
+        return self._call("GET", "list-remote-targets",
+                          {"bucket": bucket})["targets"]
+
+    def remove_remote_target(self, bucket: str, arn: str) -> None:
+        self._call("POST", "remove-remote-target",
+                   {"bucket": bucket, "arn": arn})
+
+    def add_tier(self, name: str, endpoint: str, bucket: str,
+                 access_key: str, secret_key: str,
+                 prefix: str = "") -> None:
+        self._call("POST", "add-tier", body=json.dumps({
+            "name": name, "endpoint": endpoint, "bucket": bucket,
+            "access_key": access_key, "secret_key": secret_key,
+            "prefix": prefix}).encode())
+
+    def list_tiers(self) -> list:
+        return self._call("GET", "list-tiers")["tiers"]
+
+    def remove_tier(self, name: str) -> None:
+        self._call("POST", "remove-tier", {"name": name})
+
+    # -- observability --------------------------------------------------
+
+    def trace(self, timeout: float = 3.0) -> list:
+        return self._call("GET", "trace",
+                          {"timeout": str(timeout)})["entries"]
+
+    def console_log(self, n: int = 100) -> list:
+        return self._call("GET", "console-log",
+                          {"n": str(n)})["entries"]
+
+    def profiling_start(self, interval_ms: float = 5.0) -> None:
+        self._call("POST", "profiling-start",
+                   {"intervalMs": str(interval_ms)})
+
+    def profiling_stop(self) -> dict:
+        return self._call("POST", "profiling-stop")["profile"]
+
+    def bandwidth(self, bucket: str = "") -> dict:
+        p = {"bucket": bucket} if bucket else {}
+        return self._call("GET", "bandwidth", p)
+
+    def cache_stats(self) -> dict:
+        return self._call("GET", "cache-stats")
+
+    def replication_stats(self) -> dict:
+        return self._call("GET", "replication-stats")
+
+    def top_locks(self) -> list:
+        return self._call("GET", "top-locks")["locks"]

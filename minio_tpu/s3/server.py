@@ -2123,12 +2123,18 @@ class S3Server:
                 and req.headers.get("content-type", "").startswith(
                     "multipart/form-data")):
             return self._post_policy(req)
+        if (req.method == "POST" and not req.bucket
+                and b"AssumeRoleWithWebIdentity" in req.body):
+            # WebIdentity STS is unauthenticated: the TOKEN is the
+            # credential (ref AssumeRoleWithWebIdentity handler).
+            return self.sts_web_identity(req)
         access_key = self.authenticate(req)
         req.access_key = access_key  # audit/trace attribution
         m, bucket, key, p = req.method, req.bucket, req.key, req.params
-        # STS API: POST / with Action=AssumeRole (ref cmd/sts-handlers.go).
+        # STS API: POST / (ref cmd/sts-handlers.go).
         if not bucket and m == "POST":
             return self.sts_handler(req, access_key)
+        
         self.authorize(req, access_key)
         if not bucket:
             if m == "GET":
@@ -2320,6 +2326,52 @@ class S3Server:
         c.child("SecretAccessKey", cred.secret_key)
         c.child("SessionToken", cred.session_token)
         c.child("Expiration", _iso8601(cred.expiration))
+        return S3Response(200, root.tobytes(),
+                          {"Content-Type": "application/xml"})
+
+    def sts_web_identity(self, req: S3Request) -> S3Response:
+        """AssumeRoleWithWebIdentity: validate the bearer JWT against
+        the configured OpenID secret and mint temp creds carrying the
+        token's policy claim (ref cmd/sts-handlers.go; this build
+        validates HS256 against MINIO_IDENTITY_OPENID_SECRET instead
+        of fetching an RSA JWKS — no egress in this environment)."""
+        import os as _os
+
+        from .webrpc import WebError, jwt_verify
+        form = dict(urllib.parse.parse_qsl(
+            req.body.decode("utf-8", "replace")))
+        if form.get("Action") != "AssumeRoleWithWebIdentity":
+            raise s3err.ERR_NOT_IMPLEMENTED
+        secret = _os.environ.get("MINIO_IDENTITY_OPENID_SECRET", "")
+        if not secret or self.iam is None:
+            raise s3err.ERR_NOT_IMPLEMENTED
+        token = form.get("WebIdentityToken", "")
+        try:
+            claims = jwt_verify(token, secret)
+        except WebError:
+            raise s3err.ERR_ACCESS_DENIED
+        subject = claims.get("sub", "")
+        policy_name = claims.get("policy", "")
+        if not subject or not policy_name:
+            raise s3err.ERR_ACCESS_DENIED
+        try:
+            duration = int(form.get("DurationSeconds", "3600"))
+        except ValueError:
+            raise s3err.ERR_INVALID_ARGUMENT
+        try:
+            cred = self.iam.assume_role_web_identity(
+                subject, policy_name, duration)
+        except KeyError:
+            raise s3err.ERR_ACCESS_DENIED
+        ns = "https://sts.amazonaws.com/doc/2011-06-15/"
+        root = Element("AssumeRoleWithWebIdentityResponse", ns)
+        result = root.child("AssumeRoleWithWebIdentityResult")
+        c = result.child("Credentials")
+        c.child("AccessKeyId", cred.access_key)
+        c.child("SecretAccessKey", cred.secret_key)
+        c.child("SessionToken", cred.session_token)
+        c.child("Expiration", _iso8601(cred.expiration))
+        result.child("SubjectFromWebIdentityToken", subject)
         return S3Response(200, root.tobytes(),
                           {"Content-Type": "application/xml"})
 
