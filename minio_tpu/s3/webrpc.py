@@ -156,6 +156,18 @@ class WebHandlers:
                                                   resource, {}):
             raise WebError("access denied", -32001)
 
+    @staticmethod
+    def _synthetic_request(method: str, bucket: str, key: str,
+                           headers: dict | None = None,
+                           body: bytes = b""):
+        """An S3Request as the S3 handler pipeline would have parsed it
+        — web routes funnel through the same handlers so every write/
+        read/delete policy applies uniformly."""
+        from .server import S3Request
+        enc = urllib.parse.quote(key, safe="/-_.~")
+        return S3Request(method, f"/{bucket}/{enc}", "",
+                         headers or {}, body)
+
     def rpc_ListBuckets(self, p: dict) -> dict:
         self._check(p["_user"], "s3:ListAllMyBuckets", "*")
         return {"buckets": [
@@ -203,22 +215,33 @@ class WebHandlers:
             for o in infos]}
 
     def rpc_RemoveObject(self, p: dict) -> dict:
+        """Deletes ride the S3 DELETE pipeline (synthetic request):
+        versioned buckets get delete markers, object-lock is enforced,
+        events/replication/tier cleanup fire — the reference's web
+        RemoveObject goes through the same deleteObject core
+        (cmd/web-handlers.go)."""
         bucket = p.get("bucketName", "")
         objects = p.get("objects", [])
-        from ..erasure.engine import ObjectNotFound
+        from . import errors as s3err
+        self._layer()  # raise "initializing" before any permission check
         # All-or-nothing permission check BEFORE any deletion — a
         # mid-list denial must not leave a half-deleted batch.
         for key in objects:
             self._check(p["_user"], "s3:DeleteObject",
                         f"{bucket}/{key}")
-        removed = []
+        handlers = self.server.handlers
+        removed, errors = [], []
         for key in objects:
+            sub = self._synthetic_request("DELETE", bucket, key)
             try:
-                self._layer().delete_object(bucket, key)
+                handlers.delete_object(sub)  # 204 also for missing keys
                 removed.append(key)
-            except ObjectNotFound:
-                removed.append(key)  # web UI treats missing as removed
-        return {"removed": removed}
+            except s3err.APIError as e:
+                errors.append({"object": key, "error": e.code})
+        out = {"removed": removed}
+        if errors:
+            out["errors"] = errors
+        return out
 
     def rpc_PresignedGet(self, p: dict) -> dict:
         bucket = p.get("bucketName", "")
@@ -253,6 +276,11 @@ class WebHandlers:
 
     def handle_upload(self, path: str, headers: dict,
                       body: bytes) -> tuple[int, str, bytes]:
+        """Web uploads ride the S3 PUT pipeline (synthetic request), so
+        bucket quota, object-lock defaults, bucket-default SSE,
+        compression, replication stamping and events all apply — same
+        funneling the reference's web Upload handler does through
+        putObject (cmd/web-handlers.go)."""
         try:
             user = self._authenticate_token(headers)
         except WebError:
@@ -262,16 +290,24 @@ class WebHandlers:
         key = urllib.parse.unquote(key)
         if not bucket or not key:
             return 400, "application/json", b'{"error":"bad path"}'
+        from . import errors as s3err
         try:
             self._check(user, "s3:PutObject", f"{bucket}/{key}")
-            meta = {"content-type": headers.get(
-                "content-type", "application/octet-stream")}
-            self._layer().put_object(
-                bucket, key, body, metadata=meta,
-                versioned=self.server.bucket_meta.versioning_enabled(
-                    bucket))
         except WebError:
             return 403, "application/json", b'{"error":"denied"}'
+        if self.server.handlers is None:
+            return 503, "application/json", b'{"error":"initializing"}'
+        sub = self._synthetic_request(
+            "PUT", bucket, key,
+            {"content-type": headers.get("content-type",
+                                         "application/octet-stream")},
+            body)
+        try:
+            self.server.handlers.put_object(sub)
+        except s3err.APIError as e:
+            status = 403 if e.http_status == 403 else 400
+            return status, "application/json", json.dumps(
+                {"error": e.code}).encode()
         except Exception as e:  # noqa: BLE001
             return 400, "application/json", json.dumps(
                 {"error": str(e)}).encode()
@@ -279,6 +315,10 @@ class WebHandlers:
 
     def handle_download(self, path: str, query: str,
                         ) -> tuple[int, str, bytes]:
+        """Web downloads reuse the S3 read tail (_read_object_plain) so
+        SSE-S3 objects decrypt, compressed objects decompress, and
+        tier-transitioned objects read through their tier — instead of
+        serving stored ciphertext verbatim."""
         params = dict(urllib.parse.parse_qsl(query))
         try:
             claims = jwt_verify(params.get("token", ""),
@@ -290,13 +330,27 @@ class WebHandlers:
         rest = path[len("/minio-tpu/web/download/"):]
         bucket, _, key = rest.partition("/")
         key = urllib.parse.unquote(key)
+        from . import errors as s3err
         try:
             self._check(claims.get("sub", ""), "s3:GetObject",
                         f"{bucket}/{key}")
-            data, info = self._layer().get_object(bucket, key)
         except WebError:
             return 403, "application/json", b'{"error":"denied"}'
+        if self.server.handlers is None:
+            return 503, "application/json", b'{"error":"initializing"}'
+        sub = self._synthetic_request("GET", bucket, key)
+        try:
+            data, info = self.server.handlers._read_object_plain(sub)
+        except s3err.APIError as e:
+            # 4xx/5xx pass through honestly (e.g. SSE-C key errors are
+            # 400, not "not found").
+            status = e.http_status if 400 <= e.http_status < 600 else 404
+            return status, "application/json", json.dumps(
+                {"error": e.code}).encode()
         except Exception:  # noqa: BLE001
             return 404, "application/json", b'{"error":"not found"}'
+        from ..event import event as ev
+        self.server.handlers._notify(ev.OBJECT_ACCESSED_GET, bucket,
+                                     key, info)
         return 200, info.metadata.get("content-type",
                                       "application/octet-stream"), data

@@ -210,7 +210,12 @@ class DataCrawler:
                             versioned=versioned)
                 elif action == DELETE:
                     # Expire the current version: versioned buckets get
-                    # a delete marker, unversioned delete outright.
+                    # a delete marker, unversioned delete outright — the
+                    # outright delete destroys data, so WORM applies
+                    # (ref enforceRetentionForDeletion gate on crawler
+                    # expiry, cmd/data-crawler.go:924).
+                    if not versioned and self._worm_protected(v, now):
+                        continue
                     out = self.layer.delete_object(bucket, key,
                                                    versioned=versioned)
                     v._expired = not versioned
@@ -219,6 +224,11 @@ class DataCrawler:
                             and tier_mod.is_transitioned(v.metadata)):
                         self.tiers.delete_remote(v.metadata)
                 elif action in (DELETE_VERSION, DELETE_MARKER):
+                    # Version deletes always destroy data: skip any
+                    # legal-hold/retention-protected version (markers
+                    # carry no retention metadata and pass).
+                    if self._worm_protected(v, now):
+                        continue
                     out = self.layer.delete_object(bucket, key,
                                                    v.version_id or "")
                     v._expired = True
@@ -230,6 +240,23 @@ class DataCrawler:
                 pass
             except Exception:
                 continue
+
+    @staticmethod
+    def _worm_protected(v, now: float) -> bool:
+        """True when deleting this version is forbidden by legal hold
+        or active retention (ref enforceRetentionForDeletion,
+        cmd/data-crawler.go:924). The crawler never bypasses
+        GOVERNANCE. `now` is the same clock the lifecycle decision
+        used, so expiry and WORM agree on what time it is."""
+        from ..bucket import objectlock as ol
+        try:
+            ol.check_version_delete(v.metadata, bypass_governance=False,
+                                    now=now)
+        except ol.ObjectLockError:
+            return True
+        except Exception:
+            return True  # unparseable lock metadata: fail safe, keep it
+        return False
 
     def _notify_removed(self, bucket: str, key: str, deleted) -> None:
         """ILM expiry fires the same removal events an S3 DELETE would

@@ -164,3 +164,54 @@ def test_crawler_noncurrent_expiry(stack):
     assert [v.version_id for v in versions] == [v2.version_id]
     data, _ = layer.get_object("ncv", "k")
     assert data == b"two"
+
+
+def test_crawler_expiry_respects_object_lock(stack):
+    """Lifecycle expiry must never destroy retained/legal-hold versions
+    (ref enforceRetentionForDeletion gate, cmd/data-crawler.go:924)."""
+    from minio_tpu.bucket import objectlock as ol
+    layer, bm, crawler = stack
+    layer.make_bucket("worm")
+    bm.update("worm", versioning="Enabled",
+              object_lock_xml=ol.ENABLED_XML,
+              lifecycle_xml="""<LifecycleConfiguration><Rule>
+        <Status>Enabled</Status><Prefix></Prefix>
+        <NoncurrentVersionExpiration><NoncurrentDays>7</NoncurrentDays>
+        </NoncurrentVersionExpiration>
+        </Rule></LifecycleConfiguration>""")
+    until = ol.iso8601(time.time() + 30 * DAY)
+    locked = layer.put_object(
+        "worm", "k", b"compliance",
+        metadata={ol.META_MODE: ol.COMPLIANCE,
+                  ol.META_RETAIN_UNTIL: until}, versioned=True)
+    held = layer.put_object(
+        "worm", "k", b"held",
+        metadata={ol.META_LEGAL_HOLD: "ON"}, versioned=True)
+    plain = layer.put_object("worm", "k", b"plain", versioned=True)
+    layer.put_object("worm", "k", b"latest", versioned=True)
+    # 8 days on: all three noncurrent versions are expiry candidates,
+    # but only the unprotected one may go.
+    crawler.crawl_once(now=time.time() + 8 * DAY)
+    left = {v.version_id for v in layer.list_object_versions("worm")}
+    assert locked.version_id in left
+    assert held.version_id in left
+    assert plain.version_id not in left
+
+
+def test_crawler_unversioned_expiry_respects_object_lock(stack):
+    from minio_tpu.bucket import objectlock as ol
+    layer, bm, crawler = stack
+    layer.make_bucket("worm2")
+    bm.update("worm2", lifecycle_xml="""<LifecycleConfiguration><Rule>
+        <Status>Enabled</Status><Prefix></Prefix>
+        <Expiration><Days>7</Days></Expiration>
+        </Rule></LifecycleConfiguration>""")
+    until = ol.iso8601(time.time() + 30 * DAY)
+    layer.put_object("worm2", "locked", b"keep",
+                     metadata={ol.META_MODE: ol.COMPLIANCE,
+                               ol.META_RETAIN_UNTIL: until})
+    layer.put_object("worm2", "free", b"bye")
+    crawler.crawl_once(now=time.time() + 8 * DAY)
+    assert layer.get_object_info("worm2", "locked").size == 4
+    with pytest.raises(ObjectNotFound):
+        layer.get_object_info("worm2", "free")

@@ -144,3 +144,78 @@ def test_unknown_method(server, token):
     _, port = server
     out = _rpc(port, "Nope", {}, token)
     assert out["error"]["code"] == -32601
+
+
+def test_remove_object_versioned_writes_marker(server, token):
+    """Web deletes ride the S3 DELETE path: on a versioned bucket the
+    latest version survives under a delete marker instead of being
+    destroyed (ADVICE r1: webrpc bypassed versioning/WORM)."""
+    srv, port = server
+    srv.layer.make_bucket("webv")
+    srv.bucket_meta.update("webv", versioning="Enabled")
+    info = srv.layer.put_object("webv", "doc", b"precious",
+                                versioned=True)
+    out = _rpc(port, "RemoveObject",
+               {"bucketName": "webv", "objects": ["doc"]}, token)
+    assert out["result"]["removed"] == ["doc"]
+    versions = srv.layer.list_object_versions("webv")
+    assert versions[0].delete_marker  # marker on top
+    data, _ = srv.layer.get_object("webv", "doc",
+                                   version_id=info.version_id)
+    assert data == b"precious"  # data version retained
+
+
+def test_web_download_decrypts_and_decompresses(server, token):
+    """Web download reuses the S3 read tail: SSE-S3 objects come back
+    as plaintext, not stored ciphertext (ADVICE r1)."""
+    import base64
+    from minio_tpu.crypto.sse import LocalKMS
+    srv, port = server
+    srv.handlers.kms = LocalKMS.from_env(
+        "web-key:" + base64.b64encode(b"W" * 32).decode())
+    srv.layer.make_bucket("webenc")
+    srv.bucket_meta.update("webenc", sse_xml="""
+      <ServerSideEncryptionConfiguration><Rule>
+      <ApplyServerSideEncryptionByDefault><SSEAlgorithm>AES256
+      </SSEAlgorithm></ApplyServerSideEncryptionByDefault>
+      </Rule></ServerSideEncryptionConfiguration>""")
+    plaintext = b"secret web payload " * 50
+
+    # Upload through the web route: bucket-default SSE must apply.
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("PUT", "/minio-tpu/web/upload/webenc/enc.bin",
+                 body=plaintext,
+                 headers={"Authorization": f"Bearer {token}"})
+    assert conn.getresponse().status == 200
+    conn.close()
+
+    stored, info = srv.layer.get_object("webenc", "enc.bin")
+    assert stored != plaintext  # ciphertext at rest
+
+    url_token = _rpc(port, "CreateURLToken", {},
+                     token)["result"]["token"]
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("GET", "/minio-tpu/web/download/webenc/enc.bin?"
+                 + urllib.parse.urlencode({"token": url_token}))
+    r = conn.getresponse()
+    body = r.read()
+    conn.close()
+    assert r.status == 200 and body == plaintext
+
+
+def test_web_upload_enforces_quota(server, token):
+    """Web uploads ride the S3 PUT pipeline, so hard bucket quotas
+    reject them (ADVICE r1: webrpc bypassed quota)."""
+    srv, port = server
+    srv.layer.make_bucket("webq")
+    srv.bucket_meta.update("webq", quota={"quota": 10,
+                                          "quotaType": "hard"})
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("PUT", "/minio-tpu/web/upload/webq/big",
+                 body=b"x" * 100,
+                 headers={"Authorization": f"Bearer {token}"})
+    r = conn.getresponse()
+    body = r.read()
+    conn.close()
+    assert r.status == 400
+    assert b"QuotaExceeded" in body
