@@ -9,7 +9,23 @@ import signal
 import sys
 
 
+def _honor_jax_platforms_env() -> None:
+    """A site may pin the JAX platform via sitecustomize, defeating the
+    JAX_PLATFORMS environment variable; re-assert the operator's choice
+    through jax.config before any device use (e.g. JAX_PLATFORMS=cpu to
+    keep server startup off the accelerator)."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    try:
+        import jax
+        jax.config.update("jax_platforms", want)
+    except Exception:
+        pass  # jax may be absent/initialized; codec falls back itself
+
+
 def main(argv: list[str] | None = None) -> int:
+    _honor_jax_platforms_env()
     parser = argparse.ArgumentParser(
         prog="minio-tpu",
         description="TPU-native S3-compatible erasure-coded object store")

@@ -289,7 +289,22 @@ class CacheObjectLayer:
 
     # -- writes (through + invalidate) ----------------------------------
 
-    def put_object(self, bucket: str, object_name: str, data: bytes,
+    @property
+    def supports_streaming_put(self):
+        return getattr(self.backend, "supports_streaming_put", False)
+
+    def get_object_stream(self, bucket: str, object_name: str,
+                          offset: int = 0, length: int = -1,
+                          version_id: str = ""):
+        """The cache serves whole objects (ref disk-cache whole-object
+        fills, cmd/disk-cache-backend.go): streaming reads route
+        through the caching get_object so hits/fills keep working."""
+        data, info = self.get_object(bucket, object_name, offset=offset,
+                                     length=length,
+                                     version_id=version_id)
+        return info, iter((data,) if data else ())
+
+    def put_object(self, bucket: str, object_name: str, data,
                    **kw) -> ObjectInfo:
         info = self.backend.put_object(bucket, object_name, data, **kw)
         self._drive(bucket, object_name).delete(bucket, object_name)

@@ -81,8 +81,49 @@ class ObjectInfo:
                    metadata=dict(fi.metadata), parts=list(fi.parts))
 
 
+class _LockedStream:
+    """Chunk iterator that owns a namespace read lock: released on
+    exhaustion, close(), error, or GC — so an abandoned streaming GET
+    can't pin the object's lock."""
+
+    def __init__(self, lock_ctx, gen):
+        self._ctx = lock_ctx  # already entered
+        self._gen = gen
+        self._closed = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> bytes:
+        if self._closed:
+            raise StopIteration
+        try:
+            return next(self._gen)
+        except BaseException:
+            self.close()
+            raise
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._gen.close()
+        finally:
+            self._ctx.__exit__(None, None, None)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 class ErasureObjects:
     """Object engine over one erasure set of k+m disks."""
+
+    # PUT accepts chunk readers (O(batch) streaming pipeline).
+    supports_streaming_put = True
 
     def __init__(self, disks: list[StorageAPI],
                  data_shards: int | None = None,
@@ -104,6 +145,12 @@ class ErasureObjects:
         self.k = data_shards
         self.m = parity_shards
         self.block_size = block_size
+        # Streaming-pipeline knobs: how many bytes one encode dispatch /
+        # one read window group covers. Peak data-plane memory is
+        # O(batch), independent of object size.
+        from ..utils.streams import DEFAULT_BATCH_BYTES
+        self.put_batch_bytes = DEFAULT_BATCH_BYTES
+        self.read_group_bytes = DEFAULT_BATCH_BYTES
         self.codec = Erasure(data_shards, parity_shards, block_size)
         self._codec_cache: dict[tuple[int, int], Erasure] = {}
         from ..parallel.nslock import LocalNSLock
@@ -211,12 +258,19 @@ class ErasureObjects:
             self._codec_cache[key] = codec
         return codec
 
-    def put_object(self, bucket: str, object_name: str, data: bytes,
+    def put_object(self, bucket: str, object_name: str, data,
                    metadata: dict | None = None,
                    versioned: bool = False,
                    parity_shards: int | None = None) -> ObjectInfo:
+        """Streaming block pipeline (ref Erasure.Encode block loop,
+        cmd/erasure-encode.go:73-109 + parallelWriter :36-70): `data` is
+        bytes OR a chunk reader/iterable. The stream is consumed in
+        multiples of block_size, each batch erasure-encoded in one
+        (TPU-batched) dispatch, bitrot-wrapped, and appended to the k+m
+        staged shard files under write-quorum tolerance — peak memory is
+        O(batch), never O(object)."""
+        from ..utils import streams
         self._check_bucket(bucket)
-        data = bytes(data)
         n = len(self.disks)
         m = self.m if parity_shards is None else parity_shards
         if not (0 < m <= n // 2):
@@ -225,76 +279,142 @@ class ErasureObjects:
         codec = self.codec_for(k, m)
         distribution = hash_order(f"{bucket}/{object_name}", n)
         wq = write_quorum(k, m)
-
-        shard_streams = self._encode_object(data, k, m, codec)
+        reader = streams.ensure_reader(data)
 
         version_id = new_version_id() if versioned else ""
         data_dir = new_data_dir()
         tmp_id = str(uuid.uuid4())
+        tmp_path = f"{TMP_PATH}/{tmp_id}"
+        shard_rel = f"{tmp_path}/{data_dir}/part.1"
         mod_time = now()
-        etag = hashlib.md5(data).hexdigest()
-        meta = dict(metadata or {})
-        meta["etag"] = etag
 
-        part = ObjectPartInfo(number=1, size=len(data),
-                              actual_size=len(data), etag=etag)
+        # Reuse the hash a verifying reader already computes over the
+        # consumed stream; otherwise tee our own (etag = md5 of stored
+        # bytes).
+        md5 = None if hasattr(reader, "etag") else hashlib.md5()
+        total = 0
+        # Failed writers are nilled out and skipped for the rest of the
+        # stream; quorum is re-checked per batch (ref parallelWriter
+        # degradation + reduceWriteQuorumErrs, cmd/erasure-encode.go:56-70).
+        alive = [True] * n
+        disk_errs: list = [None] * n
 
-        def write_one(i: int):
-            disk = self.disks[i]
-            shard_idx = distribution[i] - 1
-            tmp_path = f"{TMP_PATH}/{tmp_id}"
-            try:
-                if len(data) > 0:
-                    disk.create_file(MINIO_META_BUCKET,
-                                     f"{tmp_path}/{data_dir}/part.1",
-                                     shard_streams[shard_idx])
+        def append_one(i: int, payload: bytes):
+            self.disks[i].append_file(MINIO_META_BUCKET, shard_rel,
+                                      payload)
+
+        def cleanup_tmp(indices):
+            parallel_map([
+                lambda i=i: self.disks[i].delete(
+                    MINIO_META_BUCKET, tmp_path, recursive=True)
+                for i in indices])
+
+        try:
+            # Staging happens OUTSIDE the namespace lock: a slow
+            # client-paced stream must not block readers of the key.
+            # Only the commit below takes the write lock (ref NSLock
+            # placement just before the metadata write + rename,
+            # cmd/erasure-object.go:694-700).
+            for batch in streams.iter_batches(reader,
+                                              self.block_size,
+                                              self.put_batch_bytes):
+                if md5 is not None:
+                    md5.update(batch)
+                total += len(batch)
+                chunks = self._encode_batch(batch, k, m, codec)
+                live = [i for i in range(n) if alive[i]]
+                _, errs = parallel_map(
+                    [lambda i=i: append_one(
+                        i, chunks[distribution[i] - 1])
+                     for i in live])
+                for i, e in zip(live, errs):
+                    if e is not None:
+                        alive[i] = False
+                        disk_errs[i] = e
+                if sum(alive) < wq:
+                    raise QuorumError(
+                        "write quorum lost mid-stream "
+                        f"({sum(alive)}/{n}, need {wq})",
+                        [e for e in disk_errs if e is not None])
+            # A hash-verifying reader raises here when the declared
+            # md5/sha256/size doesn't match what streamed through —
+            # the staged shards are discarded, nothing committed
+            # (ref pkg/hash/reader.go verification at EOF).
+            if hasattr(reader, "verify"):
+                reader.verify()
+
+            etag = reader.etag() if md5 is None else md5.hexdigest()
+            meta = dict(metadata or {})
+            meta["etag"] = etag
+            part = ObjectPartInfo(number=1, size=total,
+                                  actual_size=total, etag=etag)
+
+            def commit_one(i: int):
+                if not alive[i]:
+                    raise disk_errs[i]
                 fi = FileInfo(
-                    volume=bucket, name=object_name, version_id=version_id,
-                    data_dir=data_dir if len(data) > 0 else "",
-                    size=len(data), mod_time=mod_time, metadata=meta,
+                    volume=bucket, name=object_name,
+                    version_id=version_id,
+                    data_dir=data_dir if total > 0 else "",
+                    size=total, mod_time=mod_time, metadata=meta,
                     parts=[part],
                     erasure=ErasureInfo(
                         data_blocks=k, parity_blocks=m,
-                        block_size=self.block_size, index=distribution[i],
+                        block_size=self.block_size,
+                        index=distribution[i],
                         distribution=list(distribution),
-                        checksums=[{"part": 1,
-                                    "algorithm": bitrot.DEFAULT_ALGORITHM,
-                                    "hash": ""}],
+                        checksums=[{
+                            "part": 1,
+                            "algorithm": bitrot.DEFAULT_ALGORITHM,
+                            "hash": ""}],
                     ),
                 )
-                disk.rename_data(MINIO_META_BUCKET, tmp_path, fi,
-                                 bucket, object_name)
-                return fi
-            except BaseException:
-                # Don't leak staged shards on failed disks (the reference
-                # deletes the tmp prefix on every error path).
                 try:
-                    disk.delete(MINIO_META_BUCKET, tmp_path, recursive=True)
-                except Exception:
-                    pass
-                raise
+                    self.disks[i].rename_data(
+                        MINIO_META_BUCKET, tmp_path, fi,
+                        bucket, object_name)
+                except BaseException:
+                    try:
+                        self.disks[i].delete(MINIO_META_BUCKET,
+                                             tmp_path, recursive=True)
+                    except Exception:
+                        pass
+                    raise
+                return fi
 
-        # Exclusive commit (ref NSLock write lock just before the
-        # metadata write + rename, cmd/erasure-object.go:694-700).
-        with self.ns_lock.write_locked(bucket, object_name):
-            _, errs = parallel_map(
-                [lambda i=i: write_one(i) for i in range(n)])
-            reduce_quorum_errs(errs, wq, "put_object")
-        if any(e is not None for e in errs):
-            # Partial failure feeds the MRF heal queue (ref addPartial,
-            # cmd/erasure-object.go:1082).
+            # Exclusive commit: the lock covers only metadata write +
+            # rename, not the body transfer.
+            with self.ns_lock.write_locked(bucket, object_name):
+                _, errs = parallel_map(
+                    [lambda i=i: commit_one(i) for i in range(n)])
+                reduce_quorum_errs(errs, wq, "put_object")
+        except BaseException:
+            # Don't leak staged shards (the reference deletes the
+            # tmp prefix on every error path).
+            cleanup_tmp(range(n))
+            raise
+        # Failed disks keep no stage and feed the MRF heal queue
+        # (ref addPartial, cmd/erasure-object.go:1082).
+        dead = [i for i in range(n) if errs[i] is not None]
+        if dead:
+            cleanup_tmp(dead)
             self.mrf.add(bucket, object_name)
         self._mark_update(bucket, object_name)
-        return ObjectInfo(bucket=bucket, name=object_name, size=len(data),
+        return ObjectInfo(bucket=bucket, name=object_name, size=total,
                           etag=etag, mod_time=mod_time,
                           version_id=version_id, metadata=meta,
                           parts=[part])
 
-    def _encode_object(self, data: bytes, k: int | None = None,
-                       m: int | None = None,
-                       codec=None) -> list[bytes]:
-        """Encode all stripe blocks (batched TPU dispatch for the full
-        blocks) and return the k+m bitrot-wrapped shard streams."""
+    def _encode_batch(self, data: bytes, k: int | None = None,
+                      m: int | None = None,
+                      codec=None) -> list[bytes]:
+        """Encode one batch (a multiple of block_size, except a final
+        short tail) into k+m bitrot-wrapped shard chunks: one batched
+        device dispatch for the full blocks (ref EncodeData per block,
+        cmd/erasure-encode.go:80 — here many blocks per dispatch), host
+        encode for the tail. Chunk framing aligns with shard_size
+        sub-blocks, so consecutive batches concatenate into a valid
+        streaming-bitrot shard file (ref cmd/bitrot-streaming.go:46)."""
         k = self.k if k is None else k
         m = self.m if m is None else m
         codec = self.codec if codec is None else codec
@@ -306,9 +426,8 @@ class ErasureObjects:
 
         nfull = len(data) // self.block_size
         if nfull:
-            # One batched device dispatch for all full blocks. Each block is
-            # zero-padded to k*shard_size (split padding semantics, ref
-            # dependency Split of cmd/erasure-coding.go:74).
+            # Each block is zero-padded to k*shard_size (split padding
+            # semantics, ref dependency Split of cmd/erasure-coding.go:74).
             full = np.frombuffer(
                 data[:nfull * self.block_size], dtype=np.uint8,
             ).reshape(nfull, self.block_size)
@@ -329,6 +448,14 @@ class ErasureObjects:
 
         return [bitrot.encode_stream(bytes(s), shard_size)
                 for s in raw_shards]
+
+    def _encode_object(self, data: bytes, k: int | None = None,
+                       m: int | None = None,
+                       codec=None) -> list[bytes]:
+        """Whole-object encode -> k+m bitrot-wrapped shard streams
+        (multipart parts and heal re-encode, which already hold the
+        part in memory)."""
+        return self._encode_batch(data, k, m, codec)
 
     # ------------------------------------------------------------------
     # read path
@@ -382,11 +509,27 @@ class ErasureObjects:
     def get_object(self, bucket: str, object_name: str, offset: int = 0,
                    length: int = -1, version_id: str = "",
                    ) -> tuple[bytes, ObjectInfo]:
+        info, stream = self.get_object_stream(bucket, object_name,
+                                              offset, length, version_id)
+        return b"".join(stream), info
+
+    def get_object_stream(self, bucket: str, object_name: str,
+                          offset: int = 0, length: int = -1,
+                          version_id: str = "",
+                          ) -> tuple[ObjectInfo, "object"]:
+        """(info, chunk iterator) — the streaming GET: blocks are
+        fetched, bitrot-verified, and reconstructed group-by-group, so
+        peak memory is O(group), never O(range) (ref blockwise decode,
+        cmd/erasure-decode.go:248-263). The read lock is held for the
+        stream's lifetime, like the reference holds its read lock across
+        the response write (cmd/erasure-object.go:134); exhaust or
+        close() the iterator to release it."""
         self._check_bucket(bucket)
         # The read lock covers metadata + data so a concurrent overwrite
-        # cannot swap the data dir between the two reads (ref read lock
-        # around GetObjectNInfo, cmd/erasure-object.go:134).
-        with self.ns_lock.read_locked(bucket, object_name):
+        # cannot swap the data dir between the two reads.
+        ctx = self.ns_lock.read_locked(bucket, object_name)
+        ctx.__enter__()
+        try:
             fi, agreed = self._quorum_file_info(bucket, object_name,
                                                 version_id)
             if fi.deleted:
@@ -401,9 +544,13 @@ class ErasureObjects:
             if offset + length > fi.size:
                 raise ValueError("invalid range")
             if length == 0 or fi.size == 0:
-                return b"", info
-            data = self._read_and_decode(fi, agreed, offset, length)
-        return data, info
+                ctx.__exit__(None, None, None)
+                return info, iter(())
+            gen = self._iter_ranges(fi, agreed, offset, length)
+            return info, _LockedStream(ctx, gen)
+        except BaseException:
+            ctx.__exit__(None, None, None)
+            raise
 
     def _shard_readers(self, fi: FileInfo,
                        agreed: list[FileInfo | None]) -> list[int | None]:
@@ -416,16 +563,15 @@ class ErasureObjects:
                 by_shard[f.erasure.index - 1] = i
         return by_shard
 
-    def _read_and_decode(self, fi: FileInfo,
-                         agreed: list[FileInfo | None],
-                         offset: int, length: int) -> bytes:
-        """Walk the object's parts, reading the covered range from each
-        (multipart objects carry one erasure-coded shard file per part,
-        ref cmd/erasure-object.go:240 per-part loop)."""
+    def _iter_ranges(self, fi: FileInfo,
+                     agreed: list[FileInfo | None],
+                     offset: int, length: int):
+        """Walk the object's parts, streaming the covered range from
+        each (multipart objects carry one erasure-coded shard file per
+        part, ref cmd/erasure-object.go:240 per-part loop)."""
         parts = fi.parts or [ObjectPartInfo(number=1, size=fi.size,
                                             actual_size=fi.size)]
         failed: set[int] = set()
-        out = bytearray()
         pos = 0
         for p in parts:
             part_start, part_end = pos, pos + p.size
@@ -435,15 +581,25 @@ class ErasureObjects:
             local_off = max(0, offset - part_start)
             local_len = min(part_end, offset + length) - (
                 part_start + local_off)
-            out += self._read_part_range(fi, agreed, p.number, p.size,
-                                         local_off, local_len, failed)
-        return bytes(out)
+            yield from self._iter_part_range(fi, agreed, p.number,
+                                             p.size, local_off,
+                                             local_len, failed)
 
-    def _read_part_range(self, fi: FileInfo,
+    def _read_and_decode(self, fi: FileInfo,
+                         agreed: list[FileInfo | None],
+                         offset: int, length: int) -> bytes:
+        return b"".join(self._iter_ranges(fi, agreed, offset, length))
+
+    def _iter_part_range(self, fi: FileInfo,
                          agreed: list[FileInfo | None],
                          part_number: int, part_size: int,
                          offset: int, length: int,
-                         failed: set[int]) -> bytes:
+                         failed: set[int]):
+        """Yield decoded plaintext of [offset, offset+length) within one
+        part, group-by-group: shard windows covering a bounded group of
+        blocks are fetched in parallel, verified, and reconstructed, so
+        memory stays O(group) for any range (ref the per-block decode
+        loop, cmd/erasure-decode.go:248-263)."""
         k, m = fi.erasure.data_blocks, fi.erasure.parity_blocks
         shard_size = fi.erasure.shard_size()
         by_shard = self._shard_readers(fi, agreed)
@@ -454,7 +610,6 @@ class ErasureObjects:
         # Block coverage of [offset, offset+length).
         start_block = offset // fi.erasure.block_size
         end_block = (offset + length - 1) // fi.erasure.block_size
-        n_cov = end_block - start_block + 1
 
         # Bitrot algorithm comes from the object's own metadata, not the
         # current default — framing stride depends on it.
@@ -463,93 +618,97 @@ class ErasureObjects:
             if cs.get("part") == part_number:
                 algo = cs.get("algorithm", algo)
 
-        # Ranged shard-file window: each full block contributes
-        # [hash][shard_size] to the stream, so blocks [b0, b1] live at
-        # byte offset b0*stride, length <= n_cov*stride (short at EOF for
-        # the last block; ref streamingBitrotReader stream offset math,
+        # Each full block contributes [hash][shard_size] to the shard
+        # stream (ref streamingBitrotReader stream offset math,
         # cmd/bitrot-streaming.go:125).
         hsz = bitrot.hash_size(algo)
         stride = hsz + shard_size
-        win_off = start_block * stride
-
-        windows: dict[int, bytes] = {}
-
-        def fetch(j: int) -> bool:
-            """Fetch shard j's stream window; False if unavailable."""
-            if j in windows:
-                return True
-            if j in failed or by_shard[j] is None:
-                return False
-            disk = self.disks[by_shard[j]]
-            f = agreed[by_shard[j]]
-            try:
-                windows[j] = disk.read_file(
-                    fi.volume,
-                    f"{fi.name}/{f.data_dir}/part.{part_number}",
-                    win_off, n_cov * stride)
-                return True
-            except Exception:
-                failed.add(j)
-                return False
-
-        # First-k-wins: fire the k data-shard reads in parallel, fall back
-        # to parity serially (ref parallelReader, cmd/erasure-decode.go:104).
+        group = max(1, self.read_group_bytes // fi.erasure.block_size)
         candidates = list(range(k)) + list(range(k, k + m))
-        parallel_map([lambda j=j: fetch(j) for j in range(k)])
-        have = [j for j in candidates if j in windows]
-        for j in candidates:
-            if len(have) >= k:
-                break
-            if j not in have and fetch(j):
-                have.append(j)
-        if len(have) < k:
-            raise QuorumError(
-                f"read quorum not met: only {len(have)}/{k} shards readable",
-                [])
 
-        def block_chunk(j: int, local: int, chunk: int) -> bytes:
-            """Extract + bitrot-verify one block's chunk from shard j's
-            window; raises BitrotMismatch."""
-            return bitrot.extract_block(windows[j], local, chunk,
-                                        shard_size, algo)
+        want_end = offset + length
 
-        out = bytearray()
-        for b in range(start_block, end_block + 1):
-            blk_len = (min(fi.erasure.block_size,
-                           part_size - b * fi.erasure.block_size))
-            chunk = ceil_frac(blk_len, k)
-            # Gather this block's chunk from k shards, verify bitrot,
-            # reconstruct on mismatch/loss.
-            shards: list[np.ndarray | None] = [None] * (k + m)
-            good = 0
-            for j in list(have) + [j for j in candidates if j not in have]:
-                if good >= k:
-                    break
-                if not fetch(j):
-                    continue
+        for g0 in range(start_block, end_block + 1, group):
+            g1 = min(g0 + group - 1, end_block)
+            n_cov = g1 - g0 + 1
+            win_off = g0 * stride
+            windows: dict[int, bytes] = {}
+
+            def fetch(j: int) -> bool:
+                """Fetch shard j's window for this group; False if
+                unavailable."""
+                if j in windows:
+                    return True
+                if j in failed or by_shard[j] is None:
+                    return False
+                disk = self.disks[by_shard[j]]
+                f = agreed[by_shard[j]]
                 try:
-                    raw = block_chunk(j, b - start_block, chunk)
-                    shards[j] = np.frombuffer(raw, dtype=np.uint8)
-                    good += 1
-                except bitrot.BitrotMismatch:
+                    windows[j] = disk.read_file(
+                        fi.volume,
+                        f"{fi.name}/{f.data_dir}/part.{part_number}",
+                        win_off, n_cov * stride)
+                    return True
+                except Exception:
                     failed.add(j)
-                    windows.pop(j, None)
-                    if j in have:
-                        have.remove(j)
-                    # heal required (ref errHealRequired ->
-                    # deepHealObject, cmd/erasure-object.go:324)
-                    self.mrf.add(fi.volume, fi.name)
-            if good < k:
+                    return False
+
+            # First-k-wins: fire the k data-shard reads in parallel,
+            # fall back to parity serially (ref parallelReader,
+            # cmd/erasure-decode.go:104).
+            parallel_map([lambda j=j: fetch(j) for j in range(k)])
+            have = [j for j in candidates if j in windows]
+            for j in candidates:
+                if len(have) >= k:
+                    break
+                if j not in have and fetch(j):
+                    have.append(j)
+            if len(have) < k:
                 raise QuorumError(
-                    f"block {b}: only {good}/{k} shards valid", [])
-            decoded = codec.decode_data_blocks(shards) \
-                if any(shards[j] is None for j in range(k)) else shards
-            block_data = b"".join(
-                decoded[j].tobytes() for j in range(k))[:blk_len]
-            out += block_data
-        # Trim to the requested range within covered blocks.
-        skip = offset - start_block * fi.erasure.block_size
-        return bytes(out[skip:skip + length])
+                    f"read quorum not met: only {len(have)}/{k} "
+                    "shards readable", [])
+
+            for b in range(g0, g1 + 1):
+                blk_len = (min(fi.erasure.block_size,
+                               part_size - b * fi.erasure.block_size))
+                chunk = ceil_frac(blk_len, k)
+                # Gather this block's chunk from k shards, verify
+                # bitrot, reconstruct on mismatch/loss.
+                shards: list[np.ndarray | None] = [None] * (k + m)
+                good = 0
+                for j in list(have) + [j for j in candidates
+                                       if j not in have]:
+                    if good >= k:
+                        break
+                    if not fetch(j):
+                        continue
+                    try:
+                        raw = bitrot.extract_block(
+                            windows[j], b - g0, chunk, shard_size, algo)
+                        shards[j] = np.frombuffer(raw, dtype=np.uint8)
+                        good += 1
+                    except bitrot.BitrotMismatch:
+                        failed.add(j)
+                        windows.pop(j, None)
+                        if j in have:
+                            have.remove(j)
+                        # heal required (ref errHealRequired ->
+                        # deepHealObject, cmd/erasure-object.go:324)
+                        self.mrf.add(fi.volume, fi.name)
+                if good < k:
+                    raise QuorumError(
+                        f"block {b}: only {good}/{k} shards valid", [])
+                decoded = codec.decode_data_blocks(shards) \
+                    if any(shards[j] is None for j in range(k)) \
+                    else shards
+                block_data = b"".join(
+                    decoded[j].tobytes() for j in range(k))[:blk_len]
+                # Trim to the requested range within this block.
+                bstart = b * fi.erasure.block_size
+                lo = max(offset, bstart) - bstart
+                hi = min(want_end, bstart + blk_len) - bstart
+                if hi > lo:
+                    yield block_data[lo:hi]
 
     # ------------------------------------------------------------------
     # delete / list

@@ -105,41 +105,88 @@ class MultipartUploads:
 
     def put_object_part(self, bucket: str, object_name: str,
                         upload_id: str, part_number: int,
-                        data: bytes,
+                        data,
                         actual_size: int | None = None) -> dict:
-        """actual_size: pre-transform (plaintext/uncompressed) length
-        when the handler encrypted or compressed the part body."""
+        """Streaming part write — same batch pipeline as a single PUT
+        (ref PutObjectPart block loop, cmd/erasure-multipart.go:342):
+        `data` is bytes or a chunk reader; memory stays O(batch).
+        actual_size: pre-transform (plaintext/uncompressed) length when
+        the handler encrypted or compressed the part body."""
+        from ..utils import streams
         eng = self.engine
         if not 1 <= part_number <= 10000:
             raise InvalidPart(f"part number {part_number}")
         up = self._load_upload(bucket, object_name, upload_id)
         dist = up["distribution"]
         base = _upload_base(bucket, object_name, upload_id)
-        data = bytes(data)
-        etag = hashlib.md5(data).hexdigest()
-        shard_streams = eng._encode_object(data)
-        part_meta = json.dumps({
-            "number": part_number, "size": len(data), "etag": etag,
-            "actualSize": (actual_size if actual_size is not None
-                           else len(data)),
-        }).encode()
+        reader = streams.ensure_reader(data)
+        n = len(eng.disks)
+        wq = write_quorum(eng.k, eng.m)
+        stage = f"{base}/part.{part_number}.{uuid.uuid4().hex}.stage"
+        md5 = None if hasattr(reader, "etag") else hashlib.md5()
+        total = 0
+        alive = [True] * n
+        disk_errs: list = [None] * n
 
-        def write_one(i: int):
-            disk = eng.disks[i]
-            j = dist[i] - 1
-            # Zero-byte parts still get an (empty) shard file so the
-            # commit/verify/heal paths see every part.N they expect.
-            disk.write_all(MINIO_META_BUCKET,
-                           f"{base}/part.{part_number}",
-                           shard_streams[j])
-            disk.write_all(MINIO_META_BUCKET,
-                           f"{base}/part.{part_number}.json", part_meta)
+        def cleanup(indices):
+            parallel_map([
+                lambda i=i: eng.disks[i].delete(MINIO_META_BUCKET, stage)
+                for i in indices])
 
-        _, errs = parallel_map(
-            [lambda i=i: write_one(i) for i in range(len(eng.disks))])
-        reduce_quorum_errs(errs, write_quorum(eng.k, eng.m),
-                           "put_object_part")
-        return {"number": part_number, "size": len(data), "etag": etag}
+        try:
+            for batch in streams.iter_batches(reader, eng.block_size,
+                                              eng.put_batch_bytes):
+                if md5 is not None:
+                    md5.update(batch)
+                total += len(batch)
+                chunks = eng._encode_batch(batch)
+                live = [i for i in range(n) if alive[i]]
+                _, errs = parallel_map(
+                    [lambda i=i: eng.disks[i].append_file(
+                        MINIO_META_BUCKET, stage, chunks[dist[i] - 1])
+                     for i in live])
+                for i, e in zip(live, errs):
+                    if e is not None:
+                        alive[i] = False
+                        disk_errs[i] = e
+                if sum(alive) < wq:
+                    raise QuorumError(
+                        f"part write quorum lost ({sum(alive)}/{n})",
+                        [e for e in disk_errs if e is not None])
+            if hasattr(reader, "verify"):
+                reader.verify()
+
+            etag = reader.etag() if md5 is None else md5.hexdigest()
+            part_meta = json.dumps({
+                "number": part_number, "size": total, "etag": etag,
+                "actualSize": (actual_size if actual_size is not None
+                               else total),
+            }).encode()
+
+            def commit_one(i: int):
+                if not alive[i]:
+                    raise disk_errs[i]
+                disk = eng.disks[i]
+                if total > 0:
+                    disk.rename_file(MINIO_META_BUCKET, stage,
+                                     MINIO_META_BUCKET,
+                                     f"{base}/part.{part_number}")
+                else:
+                    # Zero-byte parts still get an (empty) shard file so
+                    # the commit/verify/heal paths see every part.N.
+                    disk.write_all(MINIO_META_BUCKET,
+                                   f"{base}/part.{part_number}", b"")
+                disk.write_all(MINIO_META_BUCKET,
+                               f"{base}/part.{part_number}.json",
+                               part_meta)
+
+            _, errs = parallel_map(
+                [lambda i=i: commit_one(i) for i in range(n)])
+            reduce_quorum_errs(errs, wq, "put_object_part")
+        except BaseException:
+            cleanup(range(n))
+            raise
+        return {"number": part_number, "size": total, "etag": etag}
 
     def list_parts(self, bucket: str, object_name: str,
                    upload_id: str) -> list[dict]:

@@ -79,7 +79,9 @@ class ErasureServerPools:
 
     # -- objects --------------------------------------------------------
 
-    def put_object(self, bucket: str, object_name: str, data: bytes,
+    supports_streaming_put = True
+
+    def put_object(self, bucket: str, object_name: str, data,
                    metadata: dict | None = None,
                    versioned: bool = False,
                    parity_shards: int | None = None) -> ObjectInfo:
@@ -106,6 +108,14 @@ class ErasureServerPools:
                    length: int = -1, version_id: str = ""):
         return self._probe(bucket, object_name,
                            lambda p: p.get_object(
+                               bucket, object_name, offset=offset,
+                               length=length, version_id=version_id))
+
+    def get_object_stream(self, bucket: str, object_name: str,
+                          offset: int = 0, length: int = -1,
+                          version_id: str = ""):
+        return self._probe(bucket, object_name,
+                           lambda p: p.get_object_stream(
                                bucket, object_name, offset=offset,
                                length=length, version_id=version_id))
 

@@ -87,6 +87,8 @@ class ErasureSets:
     def m(self) -> int:
         return self.sets[0].m
 
+    supports_streaming_put = True
+
     def put_object(self, bucket: str, object_name: str, data: bytes,
                    metadata: dict | None = None,
                    versioned: bool = False,
@@ -98,6 +100,13 @@ class ErasureSets:
     def get_object(self, bucket: str, object_name: str, offset: int = 0,
                    length: int = -1, version_id: str = ""):
         return self.set_for(object_name).get_object(
+            bucket, object_name, offset=offset, length=length,
+            version_id=version_id)
+
+    def get_object_stream(self, bucket: str, object_name: str,
+                          offset: int = 0, length: int = -1,
+                          version_id: str = ""):
+        return self.set_for(object_name).get_object_stream(
             bucket, object_name, offset=offset, length=length,
             version_id=version_id)
 

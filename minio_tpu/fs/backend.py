@@ -139,7 +139,9 @@ class FSObjects:
 
     # -- objects ----------------------------------------------------------
 
-    def put_object(self, bucket: str, object_name: str, data: bytes,
+    supports_streaming_put = True
+
+    def put_object(self, bucket: str, object_name: str, data,
                    metadata: dict | None = None,
                    versioned: bool = False,
                    parity_shards: int | None = None) -> ObjectInfo:
@@ -147,19 +149,27 @@ class FSObjects:
         if versioned:
             # ref cmd/fs-v1.go:1090: versioned PUT -> NotImplemented
             raise MethodNotAllowed("FS backend does not support versioning")
+        from ..utils import streams
         self._check_bucket(bucket)
-        data = bytes(data)
-        etag = hashlib.md5(data).hexdigest()
-        meta = dict(metadata or {})
-        meta["etag"] = etag
+        reader = streams.ensure_reader(data)
+        md5 = None if hasattr(reader, "etag") else hashlib.md5()
+        size = 0
         dst = self._obj_path(bucket, object_name)
         self._check_key_placement(bucket, dst)
         tmp = self._tmp_path()
         try:
+            # Chunked copy: O(chunk) memory for any object size (the
+            # reference streams through fsCreateFile, cmd/fs-v1.go).
             with open(tmp, "wb") as f:
-                f.write(data)
+                while chunk := reader.read(1 << 20):
+                    if md5 is not None:
+                        md5.update(chunk)
+                    size += len(chunk)
+                    f.write(chunk)
                 f.flush()
                 os.fsync(f.fileno())
+            if hasattr(reader, "verify"):
+                reader.verify()
             os.makedirs(os.path.dirname(dst), exist_ok=True)
             os.replace(tmp, dst)  # atomic commit (ref fsRenameFile)
         except (NotADirectoryError, FileExistsError, IsADirectoryError):
@@ -168,7 +178,9 @@ class FSObjects:
         finally:
             if os.path.exists(tmp):
                 os.remove(tmp)
-        self._write_fs_json(bucket, object_name, meta, size=len(data))
+        meta = dict(metadata or {})
+        meta["etag"] = reader.etag() if md5 is None else md5.hexdigest()
+        self._write_fs_json(bucket, object_name, meta, size=size)
         return self.get_object_info(bucket, object_name)
 
     def _write_fs_json(self, bucket: str, object_name: str, meta: dict,
@@ -227,6 +239,34 @@ class FSObjects:
         with open(self._obj_path(bucket, object_name), "rb") as f:
             f.seek(offset)
             return f.read(length), info
+
+    def get_object_stream(self, bucket: str, object_name: str,
+                          offset: int = 0, length: int = -1,
+                          version_id: str = ""):
+        """(info, chunk iterator) — the FS streaming GET twin of the
+        erasure engine's, O(chunk) memory for any range."""
+        info = self.get_object_info(bucket, object_name,
+                                    version_id=version_id)
+        if offset < 0 or offset > info.size:
+            raise ValueError("invalid range")
+        if length < 0:
+            length = info.size - offset
+        if offset + length > info.size:
+            raise ValueError("invalid range")
+        path = self._obj_path(bucket, object_name)
+
+        def gen():
+            left = length
+            with open(path, "rb") as f:
+                f.seek(offset)
+                while left > 0:
+                    chunk = f.read(min(1 << 20, left))
+                    if not chunk:
+                        break
+                    left -= len(chunk)
+                    yield chunk
+
+        return info, gen()
 
     def delete_object(self, bucket: str, object_name: str,
                       version_id: str = "",
@@ -383,23 +423,39 @@ class _FSMultipart:
 
     def put_object_part(self, bucket: str, object_name: str,
                         upload_id: str, part_number: int,
-                        data: bytes,
+                        data,
                         actual_size: int | None = None) -> dict:
+        """`data` is bytes or a chunk reader — parts stream to disk in
+        O(chunk) memory like single PUTs."""
+        from ..utils import streams
         if not 1 <= part_number <= 10000:
             raise InvalidPart(f"part number {part_number}")
         self._load(bucket, object_name, upload_id)
         base = self._base(bucket, object_name, upload_id)
-        etag = hashlib.md5(data).hexdigest()
+        reader = streams.ensure_reader(data)
+        md5 = None if hasattr(reader, "etag") else hashlib.md5()
+        size = 0
         tmp = self.fs._tmp_path()
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, os.path.join(base, f"part.{part_number}"))
-        rec = {"number": part_number, "size": len(data), "etag": etag,
+        try:
+            with open(tmp, "wb") as f:
+                while chunk := reader.read(1 << 20):
+                    if md5 is not None:
+                        md5.update(chunk)
+                    size += len(chunk)
+                    f.write(chunk)
+            if hasattr(reader, "verify"):
+                reader.verify()
+            os.replace(tmp, os.path.join(base, f"part.{part_number}"))
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        etag = reader.etag() if md5 is None else md5.hexdigest()
+        rec = {"number": part_number, "size": size, "etag": etag,
                "actualSize": (actual_size if actual_size is not None
-                              else len(data))}
+                              else size)}
         with open(os.path.join(base, f"part.{part_number}.json"), "w") as f:
             json.dump(rec, f)
-        return {"number": part_number, "size": len(data), "etag": etag}
+        return {"number": part_number, "size": size, "etag": etag}
 
     def list_parts(self, bucket: str, object_name: str,
                    upload_id: str) -> list[dict]:

@@ -75,6 +75,10 @@ class StorageRPCService:
         self._disk(a).create_file(a["volume"], a["path"], p)
         return {}, b""
 
+    def rpc_append_file(self, a, p):
+        self._disk(a).append_file(a["volume"], a["path"], p)
+        return {}, b""
+
     def rpc_delete(self, a, p):
         self._disk(a).delete(a["volume"], a["path"],
                              a.get("recursive", False))
@@ -182,7 +186,29 @@ class RemoteStorage(StorageAPI):
                                         "length": length})[1]
 
     def create_file(self, volume, path, data):
-        self._call("create_file", {"volume": volume, "path": path},
+        if isinstance(data, (bytes, bytearray, memoryview)):
+            self._call("create_file", {"volume": volume, "path": path},
+                       bytes(data))
+            return
+        # Streamed write: first chunk creates/truncates, the rest append
+        # — one bounded RPC frame per chunk, never the whole object
+        # (ref storageRESTClient.CreateFile streaming body,
+        # cmd/storage-rest-client.go).
+        first = True
+        for chunk in data:
+            if first:
+                self._call("create_file",
+                           {"volume": volume, "path": path}, bytes(chunk))
+                first = False
+            else:
+                self._call("append_file",
+                           {"volume": volume, "path": path}, bytes(chunk))
+        if first:  # empty stream still creates the file
+            self._call("create_file", {"volume": volume, "path": path},
+                       b"")
+
+    def append_file(self, volume, path, data):
+        self._call("append_file", {"volume": volume, "path": path},
                    bytes(data))
 
     def delete(self, volume, path, recursive=False):

@@ -7,8 +7,11 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 
-@dataclass(frozen=True)
+@dataclass
 class APIError(Exception):
+    # NOT frozen: contextlib's generator-contextmanager __exit__ assigns
+    # exc.__traceback__ in pure Python, which a frozen dataclass rejects
+    # (FrozenInstanceError shadowing the real error).
     code: str
     description: str
     http_status: int

@@ -29,6 +29,15 @@ from .xmlutil import S3_XMLNS, Element, parse
 MAX_OBJECT_SIZE = 5 * 1024 * 1024 * 1024  # single-PUT cap (5 GiB)
 
 
+def _drain_stream(stream) -> bytes:
+    """Fully buffer a body stream (paths that still need whole-body
+    transforms: SSE, compression, signature fallback)."""
+    parts = []
+    while chunk := stream.read(1 << 20):
+        parts.append(chunk)
+    return b"".join(parts)
+
+
 def _mime_for(key: str) -> str:
     """Content type from the key's extension (ref pkg/mimedb — the
     reference ships a 4.6k-line codegen table; Python's mimetypes
@@ -86,6 +95,11 @@ class S3Request:
         self.query = query
         self.headers = headers  # lowercase keys
         self.body = body
+        # Large object PUTs arrive as a chunk reader instead of bytes
+        # (body stays b""): the handler pipes it into the engine's
+        # block pipeline without ever buffering the object.
+        self.body_stream = None
+        self.content_length = len(body)
         self.params = dict(urllib.parse.parse_qsl(
             query, keep_blank_values=True))
         path = urllib.parse.unquote(raw_path)
@@ -703,17 +717,34 @@ class S3ApiHandlers:
             raise s3err.ERR_INTERNAL_ERROR
 
     def put_object(self, req: S3Request) -> S3Response:
+        from ..utils import compress, streams
         if "x-amz-copy-source" in req.headers:
             return self.copy_object(req)
-        if len(req.body) > MAX_OBJECT_SIZE:
+        size_hint = (req.content_length if req.body_stream is not None
+                     else len(req.body))
+        if size_hint > MAX_OBJECT_SIZE:
             raise s3err.ERR_ENTITY_TOO_LARGE
-        md5_header = req.headers.get("content-md5", "")
-        if md5_header:
-            want = base64.b64decode(md5_header)
-            if hashlib.md5(req.body).digest() != want:
-                raise s3err.ERR_BAD_DIGEST
         meta = {"content-type": req.headers.get("content-type")
                 or _mime_for(req.key)}
+        # Transform paths (SSE, compression) and non-streaming layers
+        # (gateways) buffer the body; the plain path streams straight
+        # into the engine's block pipeline.
+        if req.body_stream is not None and (
+                not getattr(self.layer, "supports_streaming_put", False)
+                or self._sse_mode_for_request(req) is not None
+                or (self.compress_enabled
+                    and getattr(self.layer, "supports_transforms", True)
+                    and compress.is_compressible(
+                        req.key, meta["content-type"],
+                        max(size_hint, 0)))):
+            req.body = _drain_stream(req.body_stream)
+            req.body_stream = None
+            req.content_length = len(req.body)
+        md5_header = req.headers.get("content-md5", "")
+        want_md5 = base64.b64decode(md5_header) if md5_header else None
+        if req.body_stream is None and want_md5 is not None:
+            if hashlib.md5(req.body).digest() != want_md5:
+                raise s3err.ERR_BAD_DIGEST
         for k, v in req.headers.items():
             if k.startswith("x-amz-meta-"):
                 meta[k] = v
@@ -724,15 +755,30 @@ class S3ApiHandlers:
         if req.headers.get("x-amz-storage-class"):
             meta["x-amz-storage-class"] = req.headers[
                 "x-amz-storage-class"]
-        self._check_quota(req.bucket, len(req.body))
-        body = self._maybe_compress(req.key, req.body, meta)
-        body = self._sse_encrypt_body(req, body, meta)
+        self._check_quota(req.bucket, max(size_hint, 0))
+        if req.body_stream is not None:
+            # Verify declared md5/sha256/length at stream end — a
+            # mismatch aborts the engine write before commit (ref
+            # pkg/hash/reader.go).
+            sha_hdr = req.headers.get("x-amz-content-sha256", "")
+            want_sha = sha_hdr if len(sha_hdr) == 64 else ""
+            body = streams.HashingReader(
+                req.body_stream, want_md5=want_md5,
+                want_sha256=want_sha,
+                expect_size=req.content_length)
+        else:
+            body = self._maybe_compress(req.key, req.body, meta)
+            body = self._sse_encrypt_body(req, body, meta)
         self._replication_decision(req, meta)
         try:
             info = self.layer.put_object(
                 req.bucket, req.key, body, metadata=meta,
                 versioned=self._versioned(req.bucket),
                 parity_shards=parity)
+        except streams.ChecksumError as e:
+            if "MD5" in str(e):
+                raise s3err.ERR_BAD_DIGEST
+            raise s3err.ERR_SIGNATURE_DOES_NOT_MATCH
         except BucketNotFound:
             raise s3err.ERR_NO_SUCH_BUCKET
         except MethodNotAllowed:
@@ -914,14 +960,21 @@ class S3ApiHandlers:
                     off, ln = rng if rng is not None else (0, size)
                     data = self._sse_decrypt_read(version_id, info, okey,
                                                   off, ln)
-                elif rng is None:
-                    data, info = self.layer.get_object(
-                        req.bucket, req.key, version_id=version_id)
                 else:
-                    off, ln = rng
-                    data, info = self.layer.get_object(
-                        req.bucket, req.key, offset=off, length=ln,
-                        version_id=version_id)
+                    # Plain object: stream decoded blocks straight to
+                    # the socket when the layer supports it (O(group)
+                    # memory for any object size).
+                    off, ln = rng if rng is not None else (0, size)
+                    stream_fn = getattr(self.layer, "get_object_stream",
+                                        None)
+                    if stream_fn is not None:
+                        info, data = stream_fn(req.bucket, req.key,
+                                               offset=off, length=ln,
+                                               version_id=version_id)
+                    else:
+                        data, info = self.layer.get_object(
+                            req.bucket, req.key, offset=off, length=ln,
+                            version_id=version_id)
         except BucketNotFound:
             raise s3err.ERR_NO_SUCH_BUCKET
         except MethodNotAllowed:
@@ -940,6 +993,9 @@ class S3ApiHandlers:
         if head:
             headers["Content-Length"] = str(size)
             return S3Response(200, b"", headers)
+        if not isinstance(data, (bytes, bytearray)):
+            headers["Content-Length"] = str(
+                rng[1] if rng is not None else size)
         if rng is not None:
             off, ln = rng
             headers["Content-Range"] = (
@@ -1049,26 +1105,47 @@ class S3ApiHandlers:
 
     def put_part(self, req: S3Request) -> S3Response:
         from ..erasure.multipart import InvalidPart, UploadNotFound
-        if len(req.body) > MAX_OBJECT_SIZE:
-            raise s3err.ERR_ENTITY_TOO_LARGE
-        md5_header = req.headers.get("content-md5", "")
-        if md5_header:
-            if hashlib.md5(req.body).digest() != base64.b64decode(
-                    md5_header):
-                raise s3err.ERR_BAD_DIGEST
-        self._check_quota(req.bucket, len(req.body))
-        body, actual = req.body, None
+        from ..utils import streams
         part_number = int(req.params["partNumber"])
         pkey = self._sse_part_key(req, part_number)
-        if pkey is not None:
-            from ..crypto import sse
-            body = sse.encrypt_stream(req.body, pkey)
-            actual = len(req.body)
+        if req.body_stream is not None and (
+                pkey is not None
+                or not getattr(self.layer, "supports_streaming_put",
+                               False)):
+            # Encrypted parts (whole-part DARE transform) and
+            # non-streaming layers still buffer.
+            req.body = _drain_stream(req.body_stream)
+            req.body_stream = None
+            req.content_length = len(req.body)
+        size_hint = (req.content_length if req.body_stream is not None
+                     else len(req.body))
+        if size_hint > MAX_OBJECT_SIZE:
+            raise s3err.ERR_ENTITY_TOO_LARGE
+        md5_header = req.headers.get("content-md5", "")
+        want_md5 = base64.b64decode(md5_header) if md5_header else None
+        if req.body_stream is None and want_md5 is not None:
+            if hashlib.md5(req.body).digest() != want_md5:
+                raise s3err.ERR_BAD_DIGEST
+        self._check_quota(req.bucket, max(size_hint, 0))
+        actual = None
+        if req.body_stream is not None:
+            body = streams.HashingReader(
+                req.body_stream, want_md5=want_md5,
+                expect_size=req.content_length)
+        else:
+            body = req.body
+            if pkey is not None:
+                from ..crypto import sse
+                body = sse.encrypt_stream(req.body, pkey)
+                actual = len(req.body)
         try:
             part = self.layer.multipart.put_object_part(
                 req.bucket, req.key, req.params["uploadId"],
-                int(req.params["partNumber"]), body,
-                actual_size=actual)
+                part_number, body, actual_size=actual)
+        except streams.ChecksumError as e:
+            if "MD5" in str(e):
+                raise s3err.ERR_BAD_DIGEST
+            raise s3err.ERR_SIGNATURE_DOES_NOT_MATCH
         except UploadNotFound:
             raise s3err.ERR_NO_SUCH_UPLOAD
         except (InvalidPart, ValueError):
@@ -1834,6 +1911,9 @@ class S3Server:
             self.audit = AuditWebhook.from_env()
             self._audit_from_env = self.audit is not None
         self.crawler = None  # attached by serve when scanning is on
+        # PUT bodies at or above this size stream through the engine's
+        # block pipeline instead of buffering (O(batch) server memory).
+        self.stream_threshold = 8 * 1024 * 1024
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
@@ -1929,25 +2009,47 @@ class S3Server:
                 req.method, req.raw_path, req.query, req.headers,
                 self._lookup_secret)
         if "authorization" in req.headers:
+            if (req.body_stream is not None
+                    and "x-amz-content-sha256" not in req.headers):
+                # The canonical request then needs the actual body hash:
+                # buffer (clients virtually always send the header).
+                req.body = _drain_stream(req.body_stream)
+                req.body_stream = None
             ak = sigv4.verify_header_auth(
                 req.method, req.raw_path, req.query, req.headers,
-                hashlib.sha256(req.body).hexdigest(), self._lookup_secret)
+                "" if req.body_stream is not None
+                else hashlib.sha256(req.body).hexdigest(),
+                self._lookup_secret)
             # aws-chunked streaming upload: the seed signature just
             # verified chains the per-chunk signatures; decode + verify
-            # the payload in place (ref newSignV4ChunkedReader,
-            # cmd/streaming-signature-v4.go:156).
+            # the payload — incrementally when the body streams (ref
+            # newSignV4ChunkedReader, cmd/streaming-signature-v4.go:156).
             if req.headers.get("x-amz-content-sha256",
                                "") == sigv4.STREAMING_PAYLOAD:
                 cred, _, seed = sigv4.parse_auth_fields(req.headers)
-                req.body = sigv4.decode_streaming(
-                    req.body, self._lookup_secret(ak), cred,
-                    req.headers.get("x-amz-date", ""), seed)
                 want = req.headers.get("x-amz-decoded-content-length")
-                try:
-                    if want and int(want) != len(req.body):
-                        raise s3err.ERR_SIGNATURE_DOES_NOT_MATCH
-                except ValueError:
-                    raise s3err.ERR_INVALID_ARGUMENT
+                if req.body_stream is not None:
+                    # AWS requires the decoded length for aws-chunked;
+                    # without it the size/quota caps would be blind.
+                    if not want:
+                        raise s3err.ERR_MISSING_CONTENT_LENGTH
+                    req.body_stream = sigv4.ChunkedDecoder(
+                        req.body_stream, self._lookup_secret(ak), cred,
+                        req.headers.get("x-amz-date", ""), seed)
+                    try:
+                        req.content_length = int(want)
+                    except ValueError:
+                        raise s3err.ERR_INVALID_ARGUMENT
+                else:
+                    req.body = sigv4.decode_streaming(
+                        req.body, self._lookup_secret(ak), cred,
+                        req.headers.get("x-amz-date", ""), seed)
+                    req.content_length = len(req.body)
+                    try:
+                        if want and int(want) != len(req.body):
+                            raise s3err.ERR_SIGNATURE_DOES_NOT_MATCH
+                    except ValueError:
+                        raise s3err.ERR_INVALID_ARGUMENT
         elif "X-Amz-Signature" in req.params:
             ak = sigv4.verify_presigned(
                 req.method, req.raw_path, req.query, req.headers,
@@ -2136,6 +2238,15 @@ class S3Server:
             return self.sts_handler(req, access_key)
         
         self.authorize(req, access_key)
+        # Only plain object PUTs and part uploads consume body streams;
+        # sub-resource PUTs (?tagging, ?retention, ...) read req.body.
+        if req.body_stream is not None and (
+                not key or m != "PUT"
+                or any(q in p for q in ("tagging", "retention",
+                                        "legal-hold"))):
+            req.body = _drain_stream(req.body_stream)
+            req.body_stream = None
+            req.content_length = len(req.body)
         if not bucket:
             if m == "GET":
                 return h.list_buckets(req)
@@ -2382,6 +2493,11 @@ class S3Server:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # Socket timeout: a client that stops reading (streamed GET)
+            # or writing (streamed PUT) errors out and releases any held
+            # namespace lock instead of pinning it indefinitely (ref the
+            # reference's conn read/write deadlines, cmd/http/listener.go).
+            timeout = 120
 
             def log_message(self, *args):  # silence
                 pass
@@ -2390,9 +2506,23 @@ class S3Server:
                 t0 = time.monotonic()
                 try:
                     length = int(self.headers.get("Content-Length", 0))
-                    body = self.rfile.read(length) if length else b""
                     raw_path, _, query = self.path.partition("?")
                     headers = {k.lower(): v for k, v in self.headers.items()}
+                    # Large object PUTs stream: the socket body is never
+                    # buffered whole (ref the reference's streaming PUT
+                    # pipeline, cmd/erasure-encode.go:73).
+                    stream_body = (
+                        self.command == "PUT"
+                        and length >= server.stream_threshold
+                        and not raw_path.startswith("/minio-tpu/")
+                        and "/" in raw_path.lstrip("/"))
+                    if stream_body:
+                        from ..utils.streams import LimitReader
+                        body = b""
+                        body_stream = LimitReader(self.rfile, length)
+                    else:
+                        body = self.rfile.read(length) if length else b""
+                        body_stream = None
                     # Internal cluster RPC rides the same port
                     # (ref registerDistErasureRouters, cmd/routers.go:26).
                     if server.rpc_registry is not None and \
@@ -2421,6 +2551,9 @@ class S3Server:
                         return
                     req = S3Request(self.command, raw_path, query, headers,
                                     body)
+                    if body_stream is not None:
+                        req.body_stream = body_stream
+                        req.content_length = length
                     try:
                         resp = server.route(req)
                     except APIError as e:
@@ -2440,16 +2573,25 @@ class S3Server:
                             err.http_status,
                             err.xml(raw_path, req.request_id),
                             {"Content-Type": "application/xml"})
+                    if body_stream is not None:
+                        # Keep-alive hygiene: whatever the handler left
+                        # unread (auth failures, early errors) must be
+                        # drained before the next request parses.
+                        while body_stream.read(64 * 1024):
+                            pass
+                    body_is_stream = not isinstance(
+                        resp.body, (bytes, bytearray))
+                    resp_len = (int(resp.headers.get("Content-Length", 0))
+                                if body_is_stream else len(resp.body))
                     api = (f"{self.command}-"
                            f"{'object' if req.key else 'bucket' if req.bucket else 'service'}")
-                    server.metrics.record(api, resp.status, len(body),
-                                          len(resp.body))
-                    server.bandwidth.record(req.bucket, len(body),
-                                            len(resp.body))
+                    server.metrics.record(api, resp.status, length,
+                                          resp_len)
+                    server.bandwidth.record(req.bucket, length, resp_len)
                     server.publish_trace(
                         api, self.command, raw_path, resp.status,
-                        (time.monotonic() - t0) * 1000.0, len(body),
-                        len(resp.body), req.request_id,
+                        (time.monotonic() - t0) * 1000.0, length,
+                        resp_len, req.request_id,
                         self.client_address[0],
                         getattr(req, "access_key", ""))
                     self.send_response(resp.status)
@@ -2471,9 +2613,22 @@ class S3Server:
                         self.send_header(k, v)
                     if "Content-Length" not in resp.headers:
                         self.send_header("Content-Length",
-                                         str(len(resp.body)))
+                                         str(resp_len))
                     self.end_headers()
-                    if self.command != "HEAD" and resp.body:
+                    if self.command == "HEAD":
+                        pass
+                    elif body_is_stream:
+                        # Streaming GET: blocks flow decoded-chunk by
+                        # decoded-chunk from the engine to the socket.
+                        try:
+                            for chunk in resp.body:
+                                if chunk:
+                                    self.wfile.write(chunk)
+                        finally:
+                            close = getattr(resp.body, "close", None)
+                            if close is not None:
+                                close()
+                    elif resp.body:
                         self.wfile.write(resp.body)
                 except (BrokenPipeError, ConnectionResetError):
                     pass

@@ -313,6 +313,93 @@ def decode_streaming(body: bytes, secret: str, cred: Credential,
     return bytes(out)
 
 
+class ChunkedDecoder:
+    """Streaming aws-chunked decoder: pulls frames from an inner reader
+    one chunk at a time, verifying the chunk-signature chain — the
+    incremental twin of decode_streaming for bodies too large to buffer
+    (ref newSignV4ChunkedReader, cmd/streaming-signature-v4.go:156).
+    read(n) returns decoded payload; raises on any bad signature."""
+
+    def __init__(self, inner, secret: str, cred: Credential,
+                 amz_date: str, seed_signature: str):
+        self._inner = inner
+        self._key = _signing_key(secret, cred.date, cred.region,
+                                 cred.service)
+        self._scope = cred.scope
+        self._amz_date = amz_date
+        self._prev = seed_signature
+        self._buf = bytearray()  # decoded, not yet returned
+        self._raw = bytearray()  # undecoded wire bytes
+        self._done = False
+
+    def _fill_raw(self, n: int) -> None:
+        while len(self._raw) < n:
+            chunk = self._inner.read(64 * 1024)
+            if not chunk:
+                raise ERR_SIGNATURE_DOES_NOT_MATCH
+            self._raw += chunk
+
+    # Chunk headers are tiny ("<hex>;chunk-signature=<64 hex>"); cap the
+    # scan so a malformed body can't make us buffer it whole.
+    _MAX_HEADER = 4096
+
+    def _read_frame(self) -> None:
+        # [hex-size];chunk-signature=<sig>\r\n<data>\r\n
+        scanned = 0  # resume the CRLF search where the last one ended
+        while True:
+            nl = self._raw.find(b"\r\n", max(0, scanned - 1))
+            if nl >= 0:
+                break
+            scanned = len(self._raw)
+            if scanned > self._MAX_HEADER:
+                raise ERR_SIGNATURE_DOES_NOT_MATCH
+            chunk = self._inner.read(4096)
+            if not chunk:
+                raise ERR_SIGNATURE_DOES_NOT_MATCH
+            self._raw += chunk
+        if nl > self._MAX_HEADER:
+            raise ERR_SIGNATURE_DOES_NOT_MATCH
+        header = bytes(self._raw[:nl]).decode("ascii", "replace")
+        del self._raw[:nl + 2]
+        size_s, _, ext = header.partition(";")
+        try:
+            size = int(size_s, 16)
+        except ValueError:
+            raise ERR_SIGNATURE_DOES_NOT_MATCH
+        sig = ""
+        for kv in ext.split(";"):
+            k, _, v = kv.partition("=")
+            if k.strip() == "chunk-signature":
+                sig = v.strip()
+        if size > 0:
+            self._fill_raw(size + 2)
+            data = bytes(self._raw[:size])
+            if bytes(self._raw[size:size + 2]) != b"\r\n":
+                raise ERR_SIGNATURE_DOES_NOT_MATCH
+            del self._raw[:size + 2]
+        else:
+            data = b""  # final frame; trailing CRLF optional at EOF
+        want = hmac.new(
+            self._key,
+            _chunk_string_to_sign(self._amz_date, self._scope,
+                                  self._prev, data).encode(),
+            hashlib.sha256).hexdigest()
+        if not hmac.compare_digest(want, sig):
+            raise ERR_SIGNATURE_DOES_NOT_MATCH
+        self._prev = want
+        if size == 0:
+            self._done = True
+        else:
+            self._buf += data
+
+    def read(self, n: int) -> bytes:
+        while len(self._buf) < n and not self._done:
+            self._read_frame()
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out
+
+
 def sign_streaming_request(method: str, path: str, query: str,
                            headers: dict[str, str], body: bytes,
                            access_key: str, secret_key: str,

@@ -69,8 +69,17 @@ class StorageAPI(abc.ABC):
         """Ranged read (ref ReadFileStream)."""
 
     @abc.abstractmethod
-    def create_file(self, volume: str, path: str, data: bytes) -> None:
-        """Write a (shard) file, creating parents (ref CreateFile)."""
+    def create_file(self, volume: str, path: str, data) -> None:
+        """Write a (shard) file, creating parents (ref CreateFile,
+        cmd/xl-storage.go:1575 — a STREAMING write there). `data` is
+        bytes or an iterable of byte chunks; iterable input must be
+        written incrementally, never buffered whole."""
+
+    @abc.abstractmethod
+    def append_file(self, volume: str, path: str, data: bytes) -> None:
+        """Append a chunk to a (staging) file, creating it and parents
+        on first append (ref AppendFile, cmd/xl-storage.go). The
+        engine's block pipeline writes one erasure batch per call."""
 
     @abc.abstractmethod
     def delete(self, volume: str, path: str, recursive: bool = False,

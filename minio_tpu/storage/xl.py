@@ -170,9 +170,38 @@ class XLStorage(StorageAPI):
         except OSError as e:
             raise serr.FaultyDisk(str(e))
 
-    def create_file(self, volume: str, path: str, data: bytes) -> None:
+    def create_file(self, volume: str, path: str, data) -> None:
+        """bytes -> atomic write; iterable of chunks -> incremental
+        streaming write (ref streaming CreateFile,
+        cmd/xl-storage.go:1575). Streamed files land directly at the
+        target path: callers always stage under tmp/ and commit via
+        rename_data, so a torn stream never becomes visible."""
         self._check_vol(volume)
-        self._atomic_write(self._file_path(volume, path), bytes(data))
+        full = self._file_path(volume, path)
+        if isinstance(data, (bytes, bytearray, memoryview)):
+            self._atomic_write(full, bytes(data))
+            return
+        os.makedirs(os.path.dirname(full), exist_ok=True)
+        try:
+            with open(full, "wb") as f:
+                for chunk in data:
+                    f.write(chunk)
+        except OSError as e:
+            if e.errno == errno.ENOSPC:
+                raise serr.DiskFull(str(e))
+            raise serr.FaultyDisk(str(e))
+
+    def append_file(self, volume: str, path: str, data: bytes) -> None:
+        self._check_vol(volume)
+        full = self._file_path(volume, path)
+        os.makedirs(os.path.dirname(full), exist_ok=True)
+        try:
+            with open(full, "ab") as f:
+                f.write(data)
+        except OSError as e:
+            if e.errno == errno.ENOSPC:
+                raise serr.DiskFull(str(e))
+            raise serr.FaultyDisk(str(e))
 
     def delete(self, volume: str, path: str, recursive: bool = False,
                ) -> None:

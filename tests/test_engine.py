@@ -61,6 +61,9 @@ class NaughtyDisk(StorageAPI):
     def create_file(self, v, p, d):
         return self.__getattr__("create_file")(v, p, d)
 
+    def append_file(self, v, p, d):
+        return self.__getattr__("append_file")(v, p, d)
+
     def delete(self, v, p, recursive=False):
         return self.__getattr__("delete")(v, p, recursive)
 
@@ -170,7 +173,7 @@ def test_write_tolerates_parity_failures(tmp_path):
     (ref parallelWriter write-quorum tolerance, cmd/erasure-encode.go:56)."""
     e = make_engine(tmp_path, n=6, naughty=True)
     e.make_bucket("b")
-    e.disks[1].fail_methods = {"create_file"}
+    e.disks[1].fail_methods = {"create_file", "append_file"}
     e.disks[4].fail_methods = {"rename_data"}
     payload = os.urandom(20000)
     e.put_object("b", "tolerant", payload)
@@ -182,7 +185,7 @@ def test_write_fails_below_quorum(tmp_path):
     e = make_engine(tmp_path, n=6, naughty=True)
     e.make_bucket("b")
     for i in (0, 2, 5):
-        e.disks[i].fail_methods = {"create_file"}
+        e.disks[i].fail_methods = {"create_file", "append_file"}
     with pytest.raises(QuorumError):
         e.put_object("b", "doomed", os.urandom(10000))
 
@@ -280,7 +283,7 @@ def test_list_sees_objects_missing_on_first_disk(tmp_path):
     """Regression: listing must union across disks, not trust disk 0."""
     e = make_engine(tmp_path, n=6, naughty=True)
     e.make_bucket("b")
-    e.disks[0].fail_methods = {"create_file", "rename_data"}
+    e.disks[0].fail_methods = {"create_file", "append_file", "rename_data"}
     e.put_object("b", "hidden", b"x" * 1000)
     e.disks[0].fail_methods = set()
     names = [o.name for o in e.list_objects("b")]

@@ -131,7 +131,7 @@ def test_mrf_heals_partial_write(tmp_path):
     """A PUT with one failed disk self-heals via the MRF queue."""
     e = make_engine(tmp_path, n=4, naughty=True, block_size=4096)
     e.make_bucket("b")
-    e.disks[3].fail_methods = {"create_file"}
+    e.disks[3].fail_methods = {"create_file", "append_file"}
     payload = os.urandom(20000)
     e.put_object("b", "partial", payload)
     e.disks[3].fail_methods = set()
