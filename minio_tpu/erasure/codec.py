@@ -18,7 +18,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from ..ops import rs_cpu, rs_tpu
+from ..ops import batching, rs_cpu, rs_tpu
 from ..utils import ceil_frac
 
 # Default stripe block: 10 MiB (ref cmd/object-api-common.go:32).
@@ -93,6 +93,12 @@ class Erasure:
                 self._tpu_ok = False
         return bool(self._tpu_ok)
 
+    def _coalesce_ok(self) -> bool:
+        """Route encodes through the cross-request coalescer? Only when
+        a real device exists (the window buys nothing on host-only) and
+        the backend isn't pinned."""
+        return (self.backend == "auto" and batching.device_present())
+
     def encode_data(self, data: bytes | np.ndarray) -> np.ndarray:
         """Encode one block: returns (k+m, shard_len) uint8
         (ref EncodeData, cmd/erasure-coding.go:70)."""
@@ -101,38 +107,63 @@ class Erasure:
         if buf.size == 0:
             return np.zeros((self.total_shards, 0), dtype=np.uint8)
         shards = rs_cpu.split(buf, self.data_blocks, self.parity_blocks)
-        if self._use_tpu(buf.size):
-            return rs_tpu.encode_batch(
+        if self.backend == "tpu":
+            out = rs_tpu.encode_batch(
                 shards[None, :self.data_blocks, :],
                 self.data_blocks, self.parity_blocks)[0]
-        return rs_cpu.encode(shards, self.data_blocks, self.parity_blocks)
+            batching.STATS.add(True, shards[:self.data_blocks].nbytes)
+            return out
+        if self._coalesce_ok():
+            return batching.get_coalescer().encode(
+                shards[None, :self.data_blocks, :],
+                self.data_blocks, self.parity_blocks)[0]
+        rs_cpu.encode(shards, self.data_blocks, self.parity_blocks)
+        batching.STATS.add(False, shards[:self.data_blocks].nbytes)
+        return shards
 
     def encode_blocks_batch(self, blocks: np.ndarray) -> np.ndarray:
         """Batched encode of (B, k, S) pre-split blocks -> (B, k+m, S).
-        The heal/multipart fast path: one device dispatch for many blocks."""
+        The heal/multipart fast path: one device dispatch for many blocks
+        (and still coalescable with concurrent requests)."""
         if self._use_tpu(blocks.nbytes):
-            return rs_tpu.encode_batch(blocks, self.data_blocks,
-                                       self.parity_blocks)
-        out = np.zeros((blocks.shape[0], self.total_shards, blocks.shape[2]),
-                       dtype=np.uint8)
-        out[:, :self.data_blocks] = blocks
-        for b in range(blocks.shape[0]):
-            rs_cpu.encode(out[b], self.data_blocks, self.parity_blocks)
-        return out
+            out = rs_tpu.encode_batch(blocks, self.data_blocks,
+                                      self.parity_blocks)
+            batching.STATS.add(True, blocks.nbytes)
+            return out
+        if self._coalesce_ok():
+            return batching.get_coalescer().encode(
+                blocks, self.data_blocks, self.parity_blocks)
+        return batching.host_encode(blocks, self.data_blocks,
+                                    self.parity_blocks)
 
     def decode_data_blocks(self, shards: list[np.ndarray | None],
                            ) -> list[np.ndarray]:
         """Reconstruct missing DATA shards in place of Nones
         (ref DecodeDataBlocks, cmd/erasure-coding.go:89)."""
-        present = [s for s in shards if s is not None]
-        if len(present) == len(shards) or not present:
-            return list(shards)
-        return rs_cpu.reconstruct_data(shards, self.data_blocks,
-                                       self.parity_blocks)
+        return self.decode_data_blocks_batch([shards])[0]
 
     def decode_all_blocks(self, shards: list[np.ndarray | None],
                           ) -> list[np.ndarray]:
         """Reconstruct ALL missing shards (heal path; ref
         DecodeDataAndParityBlocks, cmd/erasure-coding.go:106)."""
-        return rs_cpu.reconstruct(shards, self.data_blocks,
-                                  self.parity_blocks)
+        return self.decode_all_blocks_batch([shards])[0]
+
+    def decode_data_blocks_batch(self, blocks: list,
+                                 ) -> list[list[np.ndarray]]:
+        """Mask-grouped batched data reconstruct: blocks sharing an
+        erasure signature collapse into one device dispatch
+        (ops/batching.py; the TPU-native replacement for the reference's
+        per-call ReconstructData, cmd/erasure-decode.go:214)."""
+        return batching.reconstruct_blocks(
+            blocks, self.data_blocks, self.parity_blocks,
+            want_all=False, use_device=self._use_tpu,
+            device_fallback=self.backend != "tpu")
+
+    def decode_all_blocks_batch(self, blocks: list,
+                                ) -> list[list[np.ndarray]]:
+        """Mask-grouped batched full reconstruct (heal): data and parity
+        rebuilt by a single combined matrix per mask group."""
+        return batching.reconstruct_blocks(
+            blocks, self.data_blocks, self.parity_blocks,
+            want_all=True, use_device=self._use_tpu,
+            device_fallback=self.backend != "tpu")

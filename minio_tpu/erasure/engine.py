@@ -668,12 +668,13 @@ class ErasureObjects:
                     f"read quorum not met: only {len(have)}/{k} "
                     "shards readable", [])
 
+            # Pass 1: gather + bitrot-verify every block's chunk in this
+            # group (views into the fetched windows, no copies).
+            gathered: list[tuple[int, int, list]] = []
             for b in range(g0, g1 + 1):
                 blk_len = (min(fi.erasure.block_size,
                                part_size - b * fi.erasure.block_size))
                 chunk = ceil_frac(blk_len, k)
-                # Gather this block's chunk from k shards, verify
-                # bitrot, reconstruct on mismatch/loss.
                 shards: list[np.ndarray | None] = [None] * (k + m)
                 good = 0
                 for j in list(have) + [j for j in candidates
@@ -698,11 +699,22 @@ class ErasureObjects:
                 if good < k:
                     raise QuorumError(
                         f"block {b}: only {good}/{k} shards valid", [])
-                decoded = codec.decode_data_blocks(shards) \
-                    if any(shards[j] is None for j in range(k)) \
-                    else shards
+                gathered.append((b, blk_len, shards))
+
+            # Pass 2: batch-reconstruct blocks with data loss — blocks
+            # of one object share an erasure mask, so the whole group is
+            # a single coalesced device dispatch (ops/batching.py).
+            need = [i for i, (_, _, sh) in enumerate(gathered)
+                    if any(sh[j] is None for j in range(k))]
+            if need:
+                decoded = codec.decode_data_blocks_batch(
+                    [gathered[i][2] for i in need])
+                for i, dec in zip(need, decoded):
+                    gathered[i] = (gathered[i][0], gathered[i][1], dec)
+
+            for b, blk_len, shards in gathered:
                 block_data = b"".join(
-                    decoded[j].tobytes() for j in range(k))[:blk_len]
+                    shards[j].tobytes() for j in range(k))[:blk_len]
                 # Trim to the requested range within this block.
                 bstart = b * fi.erasure.block_size
                 lo = max(offset, bstart) - bstart

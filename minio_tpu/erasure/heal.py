@@ -8,9 +8,10 @@ heal_object classifies each disk for the latest quorum version —
   corrupt   shard fails deep bitrot scan
 — then regenerates every missing shard from k good ones and rewrites the
 bad disks via the same tmp→rename_data commit as a PUT. Reconstruction is
-the best TPU batch source: all blocks of an object share one erasure mask,
-so the whole object heals in a few batched device dispatches
-(SURVEY §7 stage 5).
+the best TPU batch source: all blocks of an object share one erasure
+mask, so each part's blocks coalesce into a single batched device
+dispatch via codec.decode_all_blocks_batch → ops/batching.py (SURVEY §7
+stage 5; one mask group per part, tail block forming its own group).
 """
 
 from __future__ import annotations
@@ -29,6 +30,10 @@ from ..storage.xl import MINIO_META_BUCKET, TMP_PATH
 from ..utils import ceil_frac
 from . import bitrot
 from .codec import Erasure
+
+# Cap on stacked survivor bytes per coalesced heal dispatch: large
+# enough to saturate the device, small enough to bound heal memory.
+HEAL_BATCH_BYTES = 64 * 1024 * 1024
 
 
 @dataclass
@@ -169,18 +174,27 @@ class Healer:
                     algo = cs.get("algorithm", algo)
             n_blocks = ceil_frac(part.size, fi.erasure.block_size)
             acc = {j: bytearray() for j in missing_shards}
-            for b in range(n_blocks):
-                blk_len = min(fi.erasure.block_size,
-                              part.size - b * fi.erasure.block_size)
-                chunk = ceil_frac(blk_len, k)
-                shards: list[np.ndarray | None] = [None] * (k + m)
-                for j, stream in streams.items():
-                    data = bitrot.extract_block(stream, b, chunk,
-                                                shard_size, algo)
-                    shards[j] = np.frombuffer(data, dtype=np.uint8)
-                full = codec.decode_all_blocks(shards)
-                for j in missing_shards:
-                    acc[j] += full[j].tobytes()
+            # All blocks share one erasure mask -> coalesced device
+            # dispatches (ops/batching.py), bounded to HEAL_BATCH_BYTES
+            # of stacked survivors so peak memory stays O(batch), not
+            # O(part).
+            group = max(1, HEAL_BATCH_BYTES // max(fi.erasure.block_size,
+                                                   1))
+            for b0 in range(0, n_blocks, group):
+                block_shards: list[list[np.ndarray | None]] = []
+                for b in range(b0, min(b0 + group, n_blocks)):
+                    blk_len = min(fi.erasure.block_size,
+                                  part.size - b * fi.erasure.block_size)
+                    chunk = ceil_frac(blk_len, k)
+                    shards: list[np.ndarray | None] = [None] * (k + m)
+                    for j, stream in streams.items():
+                        data = bitrot.extract_block(stream, b, chunk,
+                                                    shard_size, algo)
+                        shards[j] = np.frombuffer(data, dtype=np.uint8)
+                    block_shards.append(shards)
+                for full in codec.decode_all_blocks_batch(block_shards):
+                    for j in missing_shards:
+                        acc[j] += full[j].tobytes()
             rebuilt[part.number] = acc
 
         # Write regenerated shards to the bad disks (tmp -> rename_data,

@@ -57,6 +57,32 @@ def parity_matrix(data_shards: int, parity_shards: int) -> np.ndarray:
     return encode_matrix(data_shards, parity_shards)[data_shards:]
 
 
+@lru_cache(maxsize=4096)
+def any_decode_matrix(data_shards: int, parity_shards: int,
+                      available: tuple[int, ...],
+                      missing: tuple[int, ...],
+                      ) -> tuple[np.ndarray, tuple[int, ...]]:
+    """Matrix rebuilding arbitrary missing shards (data AND parity) from
+    the first-k survivors, in ONE application.
+
+    Data rows come straight from the decode matrix; a missing parity row
+    p is enc[p] @ dec (parity = enc_row @ data and data = dec @ survivors),
+    so heal's full-shard regeneration is a single matmul instead of
+    decode-then-re-encode (ref DecodeDataAndParityBlocks,
+    cmd/erasure-coding.go:106, done there as two passes).
+
+    Returns ((len(missing), k) matrix, used_shard_indices).
+    """
+    dec, used = decode_matrix(data_shards, parity_shards, list(available))
+    enc = encode_matrix(data_shards, parity_shards)
+    rows = [dec[i] if i < data_shards else gf_matmul(enc[i:i + 1], dec)[0]
+            for i in missing]
+    mat = (np.stack(rows).astype(np.uint8) if rows
+           else np.zeros((0, data_shards), dtype=np.uint8))
+    mat.setflags(write=False)
+    return mat, tuple(used)
+
+
 def decode_matrix(data_shards: int, parity_shards: int,
                   available: list[int]) -> tuple[np.ndarray, list[int]]:
     """Build the data-reconstruction matrix for a given availability set.

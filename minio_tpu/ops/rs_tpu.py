@@ -35,7 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .gf256 import gf_matrix_to_bitplane
-from .rs_matrix import decode_matrix, parity_matrix
+from .rs_matrix import any_decode_matrix, decode_matrix, parity_matrix
 
 # --- host-side matrix prep ----------------------------------------------------
 
@@ -56,6 +56,17 @@ def decode_bitplane(k: int, m: int, available: tuple[int, ...],
     dec, used = decode_matrix(k, m, list(available))
     rows = dec[list(missing), :]
     return gf_matrix_to_bitplane(rows).astype(np.float32), used
+
+
+@lru_cache(maxsize=1024)
+def any_decode_bitplane(k: int, m: int, available: tuple[int, ...],
+                        missing: tuple[int, ...],
+                        ) -> tuple[np.ndarray, tuple[int, ...]]:
+    """Bit-plane matrix rebuilding arbitrary missing shards (data and
+    parity) from survivors — one matmul serves GET-with-loss and heal
+    (see rs_matrix.any_decode_matrix)."""
+    mat, used = any_decode_matrix(k, m, available, missing)
+    return gf_matrix_to_bitplane(mat).astype(np.float32), used
 
 
 # --- device kernel ------------------------------------------------------------
