@@ -66,6 +66,92 @@ def digest_chunks(algo: str, data: bytes, chunk_size: int) -> list[bytes]:
             for i in range(n)]
 
 
+# --- batched (device) hashing -------------------------------------------------
+
+# Coalesced full-chunk bytes at or above this go to the TPU kernel
+# (ops/hh256_tpu.py); below it, host hashing (C++ native) wins because of
+# the ~80ms relay dispatch latency — same policy shape as the RS codec's
+# TPU_MIN_BYTES (erasure/codec.py).
+HH_TPU_MIN_BYTES = 4 * 1024 * 1024
+
+
+def _device_hash_ok(algo: str, chunk_size: int, total_full_bytes: int,
+                    ) -> bool:
+    if algo not in (HIGHWAYHASH256, HIGHWAYHASH256S):
+        return False
+    if chunk_size <= 0 or total_full_bytes < HH_TPU_MIN_BYTES:
+        return False
+    from ..ops import batching
+    return batching.device_present()
+
+
+def _hash_rows_device(stacked, total_bytes: int, n_requests: int):
+    """One device dispatch over (B, L) uint8 rows -> (B, 32) digests or
+    None on device failure (callers fall back to the host). The batch
+    dim pads to the next power of two so jit shapes stay few; padded
+    rows' digests are discarded. HH_STATS counts the outcome either
+    way."""
+    import numpy as np
+
+    from ..ops import batching
+    try:
+        from ..ops import hh256_tpu
+        B = stacked.shape[0]
+        cap = 1 << max(B - 1, 0).bit_length()
+        if cap != B:
+            stacked = np.concatenate(
+                [stacked,
+                 np.zeros((cap - B, stacked.shape[1]), np.uint8)])
+        digs = hh256_tpu.hash_chunks(stacked)[:B]
+        batching.HH_STATS.add(True, total_bytes, n_requests)
+        return digs
+    except Exception as exc:  # noqa: BLE001 - degrade loudly, don't fail IO
+        batching._warn_device_fallback(exc)
+        batching.HH_STATS.add(False, total_bytes, n_requests)
+        return None
+
+
+def digest_chunks_many(algo: str, streams: list[bytes], chunk_size: int,
+                       ) -> list[list[bytes]]:
+    """Per-stream chunk digests, with all full chunks of all streams
+    hashed in ONE device dispatch when the coalesced bytes clear the
+    policy threshold (the bitrot half of the TPU data plane; north star
+    per BASELINE.json — ref cmd/bitrot-streaming.go hashes chunk-by-
+    chunk on the CPU, per shard, per block).
+
+    Ragged tail chunks (len % chunk_size) hash on the host: the device
+    kernel handles equal-length chunks only.
+    """
+    full_counts = [len(s) // chunk_size for s in streams]
+    total_full = sum(full_counts) * chunk_size
+    if not _device_hash_ok(algo, chunk_size, total_full):
+        return [digest_chunks(algo, s, chunk_size) for s in streams]
+
+    import numpy as np
+    stacked = np.empty((sum(full_counts), chunk_size), dtype=np.uint8)
+    row = 0
+    for s, nf in zip(streams, full_counts):
+        if nf:
+            stacked[row:row + nf] = np.frombuffer(
+                s, dtype=np.uint8, count=nf * chunk_size).reshape(
+                    nf, chunk_size)
+            row += nf
+    digs = _hash_rows_device(stacked, total_full, len(streams))
+    if digs is None:
+        return [digest_chunks(algo, s, chunk_size) for s in streams]
+
+    out: list[list[bytes]] = []
+    row = 0
+    for s, nf in zip(streams, full_counts):
+        hs = [digs[row + i].tobytes() for i in range(nf)]
+        row += nf
+        tail = s[nf * chunk_size:]
+        if tail:
+            hs.append(digest(algo, tail))
+        out.append(hs)
+    return out
+
+
 def bitrot_shard_file_size(size: int, shard_size: int, algo: str) -> int:
     """On-disk size of a shard file including interleaved hashes
     (ref cmd/bitrot.go:140)."""
@@ -88,6 +174,57 @@ def encode_stream(data: bytes, shard_size: int,
         out += h
         out += data[i * shard_size:(i + 1) * shard_size]
     return bytes(out)
+
+
+def encode_streams(streams: list[bytes], shard_size: int,
+                   algo: str = DEFAULT_ALGORITHM) -> list[bytes]:
+    """Batched encode_stream: frame many shards' bytes, hashing ALL
+    their sub-blocks in one (device-eligible) digest_chunks_many call —
+    the write-path entry for TPU bitrot (engine._encode_batch hands the
+    k+m shards of a whole PUT batch here at once)."""
+    if not is_streaming(algo):
+        return list(streams)
+    all_hashes = digest_chunks_many(algo, streams, shard_size)
+    out: list[bytes] = []
+    for data, hs in zip(streams, all_hashes):
+        buf = bytearray()
+        for i, h in enumerate(hs):
+            buf += h
+            buf += data[i * shard_size:(i + 1) * shard_size]
+        out.append(bytes(buf))
+    return out
+
+
+def verify_frames(datas: list, wants: list[bytes],
+                  algo: str = DEFAULT_ALGORITHM) -> list[bool]:
+    """Batch-verify many [hash][block] frames: datas[i] (bytes or uint8
+    view) must hash to wants[i]. Equal-length frames coalesce into one
+    device dispatch when the policy allows (the read-path entry for TPU
+    bitrot — ref streamingBitrotReader verify-per-chunk,
+    cmd/bitrot-streaming.go:115, lifted to a batch)."""
+    by_len: dict[int, list[int]] = {}
+    for i, d in enumerate(datas):
+        by_len.setdefault(len(d), []).append(i)
+    ok = [False] * len(datas)
+    for length, idxs in by_len.items():
+        total = length * len(idxs)
+        if length and _device_hash_ok(algo, length, total):
+            import numpy as np
+            stacked = np.stack([
+                np.frombuffer(datas[i], dtype=np.uint8)
+                if not isinstance(datas[i], np.ndarray) else datas[i]
+                for i in idxs])
+            digs = _hash_rows_device(stacked, total, len(idxs))
+            if digs is not None:
+                for row, i in enumerate(idxs):
+                    ok[i] = digs[row].tobytes() == wants[i]
+                continue
+        for i in idxs:
+            d = datas[i]
+            if not isinstance(d, (bytes, bytearray)):
+                d = bytes(d)
+            ok[i] = digest(algo, d) == wants[i]
+    return ok
 
 
 class BitrotMismatch(Exception):

@@ -208,13 +208,25 @@ class ErasureObjects:
         self._mark_update(bucket)
 
     def list_buckets(self) -> list[dict]:
-        for disk in self.disks:
-            try:
-                vols = disk.list_volumes()
-                return [disk.stat_volume(v) for v in vols]
-            except serr.StorageError:
-                continue
-        return []
+        """Union of volumes across disks (parallel, dedup by name).
+
+        First-healthy-disk semantics (ref cmd/erasure-bucket.go) break
+        when a wiped replacement disk answers with an empty listing —
+        the union matches bucket_exists' any-disk view, so healing can
+        still find buckets that a fresh disk doesn't hold yet."""
+        def one(disk):
+            return [disk.stat_volume(v) for v in disk.list_volumes()]
+
+        results, _ = parallel_map(
+            [lambda d=d: one(d) for d in self.disks])
+        seen: dict[str, dict] = {}
+        for stats in results:
+            for st in stats or []:
+                cur = seen.get(st["name"])
+                if cur is None or st.get("created", 0) < cur.get(
+                        "created", 0):
+                    seen[st["name"]] = st
+        return sorted(seen.values(), key=lambda s: s["name"])
 
     def bucket_exists(self, bucket: str) -> bool:
         """True if any reachable disk has the bucket and no not-found
@@ -446,8 +458,8 @@ class ErasureObjects:
             for j in range(n):
                 raw_shards[j] += shards[j].tobytes()
 
-        return [bitrot.encode_stream(bytes(s), shard_size)
-                for s in raw_shards]
+        return bitrot.encode_streams([bytes(s) for s in raw_shards],
+                                     shard_size)
 
     def _encode_object(self, data: bytes, k: int | None = None,
                        m: int | None = None,
@@ -620,8 +632,11 @@ class ErasureObjects:
 
         # Each full block contributes [hash][shard_size] to the shard
         # stream (ref streamingBitrotReader stream offset math,
-        # cmd/bitrot-streaming.go:125).
-        hsz = bitrot.hash_size(algo)
+        # cmd/bitrot-streaming.go:125). Whole-file (non-streaming)
+        # algorithms have no interleaved hashes: stride is bare
+        # shard_size and per-frame verify is skipped (their checksum
+        # lives in metadata and is checked by verify_file deep scans).
+        hsz = bitrot.hash_size(algo) if bitrot.is_streaming(algo) else 0
         stride = hsz + shard_size
         group = max(1, self.read_group_bytes // fi.erasure.block_size)
         candidates = list(range(k)) + list(range(k, k + m))
@@ -669,26 +684,58 @@ class ErasureObjects:
                     "shards readable", [])
 
             # Pass 1: gather + bitrot-verify every block's chunk in this
-            # group (views into the fetched windows, no copies).
-            gathered: list[tuple[int, int, list]] = []
+            # group (views into the fetched windows, no copies). All
+            # frames of all fetched windows verify in ONE batched call —
+            # bitrot.verify_frames coalesces equal-length frames into a
+            # single device dispatch (the read half of the TPU bitrot
+            # path; ref streamingBitrotReader verifies per chunk on the
+            # CPU, cmd/bitrot-streaming.go:115).
+            metas = []
             for b in range(g0, g1 + 1):
                 blk_len = (min(fi.erasure.block_size,
                                part_size - b * fi.erasure.block_size))
-                chunk = ceil_frac(blk_len, k)
-                shards: list[np.ndarray | None] = [None] * (k + m)
-                good = 0
-                for j in list(have) + [j for j in candidates
-                                       if j not in have]:
-                    if good >= k:
-                        break
-                    if not fetch(j):
+                metas.append((b, blk_len, ceil_frac(blk_len, k)))
+
+            frame_ok: dict[tuple[int, int], np.ndarray] = {}
+            verified: set[int] = set()
+
+            def verify_window(js: list[int]) -> None:
+                """Batch-verify all frames of windows js; populate
+                frame_ok, mark bad shards failed + heal-queued."""
+                datas, wants, keys = [], [], []
+                bad: set[int] = set()
+                for j in js:
+                    win = windows.get(j)
+                    if win is None:
                         continue
-                    try:
-                        raw = bitrot.extract_block(
-                            windows[j], b - g0, chunk, shard_size, algo)
-                        shards[j] = np.frombuffer(raw, dtype=np.uint8)
-                        good += 1
-                    except bitrot.BitrotMismatch:
+                    for bi, (b, _bl, chunk) in enumerate(metas):
+                        base = bi * stride
+                        if len(win) < base + hsz + chunk:
+                            bad.add(j)
+                            continue
+                        if bitrot.is_streaming(algo):
+                            datas.append(np.frombuffer(
+                                win, np.uint8, count=chunk,
+                                offset=base + hsz))
+                            wants.append(bytes(win[base:base + hsz]))
+                            keys.append((j, b))
+                        else:
+                            frame_ok[(j, b)] = np.frombuffer(
+                                win, np.uint8, count=chunk, offset=base)
+                oks = bitrot.verify_frames(datas, wants, algo) \
+                    if datas else []
+                for (j, b), okv, raw in zip(keys, oks, datas):
+                    if okv:
+                        frame_ok[(j, b)] = raw
+                    else:
+                        bad.add(j)
+                for j in js:
+                    if j in bad:
+                        # Drop the shard's surviving frames too: one
+                        # rotten frame distrusts the whole window (the
+                        # reference aborts the shard stream likewise).
+                        for b, _bl, _c in metas:
+                            frame_ok.pop((j, b), None)
                         failed.add(j)
                         windows.pop(j, None)
                         if j in have:
@@ -696,6 +743,30 @@ class ErasureObjects:
                         # heal required (ref errHealRequired ->
                         # deepHealObject, cmd/erasure-object.go:324)
                         self.mrf.add(fi.volume, fi.name)
+                    elif j in windows:
+                        verified.add(j)
+
+            verify_window(list(have))
+            # Top up: if corruption dropped us below k shards, pull in
+            # spare candidates (parity first-fallback order) until k
+            # verified windows exist or candidates run out.
+            for j in candidates:
+                if len(verified) >= k:
+                    break
+                if j not in verified and fetch(j):
+                    verify_window([j])
+
+            gathered: list[tuple[int, int, list]] = []
+            for b, blk_len, chunk in metas:
+                shards: list[np.ndarray | None] = [None] * (k + m)
+                good = 0
+                for j in sorted(verified):
+                    if good >= k:
+                        break
+                    raw = frame_ok.get((j, b))
+                    if raw is not None:
+                        shards[j] = raw
+                        good += 1
                 if good < k:
                     raise QuorumError(
                         f"block {b}: only {good}/{k} shards valid", [])

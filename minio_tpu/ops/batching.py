@@ -96,6 +96,11 @@ class DispatchStats:
 
 STATS = DispatchStats()
 
+# Bitrot (HighwayHash) dispatch counters — same honesty contract as the
+# RS counters above, separate instance so operators can see which half
+# of the data plane (coding vs hashing) actually reached the device.
+HH_STATS = DispatchStats()
+
 
 class ReconstructError(ValueError):
     """Not enough survivor shards to rebuild a block."""

@@ -1,0 +1,186 @@
+"""TPU HighwayHash kernel: byte-identity with the spec implementation,
+batched digest/verify parity, and honesty counters proving the engine's
+write/read paths actually reach the device dispatch (CPU-jax here; same
+XLA semantics as TPU)."""
+
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from minio_tpu.erasure import bitrot
+from minio_tpu.ops import batching
+from minio_tpu.ops.hh256 import MAGIC_KEY, hh256
+from minio_tpu.ops import hh256_tpu
+
+
+@pytest.mark.parametrize("B,L", [(1, 32), (2, 64), (7, 96), (4, 4096),
+                                 (16, 1024), (3, 32 * 37)])
+def test_kernel_matches_reference(B, L):
+    rng = np.random.default_rng(B * 1000 + L)
+    chunks = rng.integers(0, 256, (B, L)).astype(np.uint8)
+    got = hh256_tpu.hash_chunks(chunks)
+    want = np.stack([np.frombuffer(hh256(chunks[b].tobytes()), np.uint8)
+                     for b in range(B)])
+    assert np.array_equal(got, want)
+
+
+def test_kernel_magic_key_vector_32aligned():
+    """Device kernel reproduces known digests under the zero key for
+    32-aligned inputs (the magic vector itself is 100 bytes, so it runs
+    through the host path; pin a 32-aligned derivative instead)."""
+    data = (b"0123456789abcdef" * 4)  # 64 bytes
+    got = hh256_tpu.hash_chunks(
+        np.frombuffer(data, np.uint8)[None, :], b"\x00" * 32)
+    assert got[0].tobytes() == hh256(data, b"\x00" * 32)
+
+
+@pytest.mark.parametrize("L", [1, 3, 5, 16, 17, 31, 33, 47, 63, 100,
+                               2731])
+def test_kernel_unaligned_lengths(L):
+    """Remainder handling in-kernel: every len % 32 layout variant
+    (including the real-world shard_size 2731 = ceil(8192/3))."""
+    rng = np.random.default_rng(L)
+    chunks = rng.integers(0, 256, (3, L)).astype(np.uint8)
+    got = hh256_tpu.hash_chunks(chunks)
+    want = np.stack([np.frombuffer(hh256(chunks[b].tobytes()), np.uint8)
+                     for b in range(3)])
+    assert np.array_equal(got, want)
+
+
+def test_kernel_rejects_empty():
+    with pytest.raises(ValueError):
+        hh256_tpu.hash_chunks(np.zeros((2, 0), np.uint8))
+
+
+@pytest.fixture
+def force_device(monkeypatch):
+    """Pretend a device exists and drop the byte threshold so the
+    device path runs under CPU jax."""
+    monkeypatch.setattr(batching, "_device_present", True)
+    monkeypatch.setattr(bitrot, "HH_TPU_MIN_BYTES", 1)
+    batching.HH_STATS.reset()
+    yield
+    batching.HH_STATS.reset()
+
+
+def test_digest_chunks_many_parity(force_device):
+    rng = np.random.default_rng(7)
+    streams = [rng.integers(0, 256, n).astype(np.uint8).tobytes()
+               for n in (256, 300, 64, 31, 0)]
+    got = bitrot.digest_chunks_many(bitrot.DEFAULT_ALGORITHM, streams, 64)
+    want = [bitrot.digest_chunks(bitrot.DEFAULT_ALGORITHM, s, 64)
+            for s in streams]
+    assert got == want
+    s = batching.HH_STATS.snapshot()
+    assert s["tpu_dispatches"] == 1
+    assert s["coalesced_requests"] == len(streams)
+
+
+def test_digest_chunks_many_host_below_threshold(monkeypatch):
+    monkeypatch.setattr(batching, "_device_present", True)
+    batching.HH_STATS.reset()
+    streams = [b"x" * 64]
+    got = bitrot.digest_chunks_many(bitrot.DEFAULT_ALGORITHM, streams, 64)
+    assert got == [bitrot.digest_chunks(bitrot.DEFAULT_ALGORITHM,
+                                        streams[0], 64)]
+    assert batching.HH_STATS.snapshot()["tpu_dispatches"] == 0
+
+
+def test_encode_streams_matches_encode_stream(force_device):
+    rng = np.random.default_rng(9)
+    streams = [rng.integers(0, 256, n).astype(np.uint8).tobytes()
+               for n in (4096, 4097, 100, 0)]
+    got = bitrot.encode_streams(streams, 1024)
+    want = [bitrot.encode_stream(s, 1024) for s in streams]
+    assert got == want
+    assert batching.HH_STATS.snapshot()["tpu_dispatches"] == 1
+
+
+def test_verify_frames_batched(force_device):
+    rng = np.random.default_rng(11)
+    datas = [rng.integers(0, 256, 128).astype(np.uint8).tobytes()
+             for _ in range(5)]
+    wants = [bitrot.digest(bitrot.DEFAULT_ALGORITHM, d) for d in datas]
+    wants[2] = b"\x00" * 32  # corrupt one expectation
+    ok = bitrot.verify_frames(list(datas), wants)
+    assert ok == [True, True, False, True, True]
+    assert batching.HH_STATS.snapshot()["tpu_dispatches"] == 1
+
+
+def test_verify_frames_mixed_lengths(force_device):
+    """Unequal frames still verify (tail frames hash on host)."""
+    datas = [b"a" * 128, b"b" * 128, b"c" * 37]
+    wants = [bitrot.digest(bitrot.DEFAULT_ALGORITHM, d) for d in datas]
+    assert bitrot.verify_frames(datas, wants) == [True, True, True]
+
+
+# --- engine integration: PUT hashes on device, GET verifies on device --------
+
+
+def _make_engine(tmp_path, n=6, block_size=8192):
+    from minio_tpu.erasure.engine import ErasureObjects
+    from minio_tpu.storage.xl import XLStorage
+    disks = [XLStorage(str(tmp_path / f"disk{i}")) for i in range(n)]
+    return ErasureObjects(disks, block_size=block_size)
+
+
+def test_engine_put_get_device_hash_path(tmp_path, force_device):
+    e = _make_engine(tmp_path)
+    e.make_bucket("b")
+    payload = os.urandom(8192 * 4 + 123)
+    before = batching.HH_STATS.snapshot()
+    e.put_object("b", "obj", payload)
+    mid = batching.HH_STATS.snapshot()
+    assert mid["tpu_dispatches"] > before["tpu_dispatches"], \
+        "PUT bitrot hashing must reach the device dispatch"
+    got, _ = e.get_object("b", "obj")
+    after = batching.HH_STATS.snapshot()
+    assert got == payload
+    assert after["tpu_dispatches"] > mid["tpu_dispatches"], \
+        "GET bitrot verify must reach the device dispatch"
+
+
+def test_engine_get_detects_corruption_device_path(tmp_path, force_device):
+    e = _make_engine(tmp_path)
+    e.make_bucket("b")
+    payload = os.urandom(8192 * 3)
+    e.put_object("b", "obj", payload)
+    # Flip one byte inside one shard file's first frame payload.
+    root = e.disks[2].root
+    objdir = os.path.join(root, "b", "obj")
+    ddir = next(d for d in os.listdir(objdir) if d != "xl.meta")
+    part = os.path.join(objdir, ddir, "part.1")
+    blob = bytearray(open(part, "rb").read())
+    blob[40] ^= 0xFF
+    open(part, "wb").write(bytes(blob))
+    got, _ = e.get_object("b", "obj")
+    assert got == payload  # reconstructed around the rotten shard
+
+
+def test_engine_shard_files_identical_with_and_without_device(tmp_path,
+                                                              monkeypatch):
+    """The device hash path must be invisible on disk: same framed
+    bytes as the host path (golden guard for the kernel)."""
+    payload = os.urandom(8192 * 2 + 7)
+
+    def put_and_slurp(sub, force):
+        if force:
+            monkeypatch.setattr(batching, "_device_present", True)
+            monkeypatch.setattr(bitrot, "HH_TPU_MIN_BYTES", 1)
+        else:
+            monkeypatch.setattr(batching, "_device_present", False)
+            monkeypatch.setattr(bitrot, "HH_TPU_MIN_BYTES", 1 << 60)
+        e = _make_engine(tmp_path / sub)
+        e.make_bucket("b")
+        e.put_object("b", "obj", payload)
+        files = {}
+        for i, d in enumerate(e.disks):
+            objdir = os.path.join(d.root, "b", "obj")
+            ddir = next(x for x in os.listdir(objdir) if x != "xl.meta")
+            files[i] = open(os.path.join(objdir, ddir, "part.1"),
+                            "rb").read()
+        return files
+
+    assert put_and_slurp("dev", True) == put_and_slurp("host", False)
