@@ -276,7 +276,21 @@ def _serve(args) -> int:
     crawler.start()
     server.crawler = crawler
 
+    # Auto-heal freshly replaced disks (ref monitorLocalDisksAndHeal,
+    # cmd/background-newdisks-heal-ops.go:113).
+    monitors = []
+    for pool in getattr(layer, "pools", [layer]):
+        for es in getattr(pool, "sets", [pool]):
+            mon = getattr(es, "new_disk_monitor", None)
+            if mon is not None:
+                mon.interval = float(os.environ.get(
+                    "MINIO_HEAL_NEWDISK_INTERVAL", "10"))
+                mon.start()
+                monitors.append(mon)
+
     _wait_for_sigterm()
+    for mon in monitors:
+        mon.stop()
     crawler.stop()
     server.stop()
     return 0

@@ -154,10 +154,13 @@ class ErasureObjects:
         self.codec = Erasure(data_shards, parity_shards, block_size)
         self._codec_cache: dict[tuple[int, int], Erasure] = {}
         from ..parallel.nslock import LocalNSLock
-        from .heal import Healer, MRFQueue
+        from .heal import Healer, MRFQueue, NewDiskMonitor
         from .multipart import MultipartUploads
         self.healer = Healer(self)
         self.mrf = MRFQueue(self.healer)
+        # Not started by default; the server boot starts it (tests and
+        # library users drive tick() directly).
+        self.new_disk_monitor = NewDiskMonitor(self.healer)
         self.multipart = MultipartUploads(self)
         # Namespace locks: in-process by default; distributed deployments
         # inject a dsync-backed provider (ref ObjectLayer.NewNSLock).
@@ -208,25 +211,36 @@ class ErasureObjects:
         self._mark_update(bucket)
 
     def list_buckets(self) -> list[dict]:
-        """Union of volumes across disks (parallel, dedup by name).
+        """Volumes held by a MAJORITY of responding disks.
 
         First-healthy-disk semantics (ref cmd/erasure-bucket.go) break
-        when a wiped replacement disk answers with an empty listing —
-        the union matches bucket_exists' any-disk view, so healing can
-        still find buckets that a fresh disk doesn't hold yet."""
+        when a wiped replacement disk answers with an empty listing;
+        a plain union breaks the other way, resurrecting buckets that
+        were deleted at write quorum while one disk was offline (the
+        stale minority copy would reappear). Majority-of-responding
+        matches both: a fresh disk is a minority of absences, a stale
+        survivor is a minority of presences."""
         def one(disk):
             return [disk.stat_volume(v) for v in disk.list_volumes()]
 
-        results, _ = parallel_map(
+        results, errs = parallel_map(
             [lambda d=d: one(d) for d in self.disks])
+        responding = sum(1 for e in errs if e is None)
         seen: dict[str, dict] = {}
-        for stats in results:
+        counts: dict[str, int] = {}
+        for stats, e in zip(results, errs):
+            if e is not None:
+                continue
             for st in stats or []:
+                counts[st["name"]] = counts.get(st["name"], 0) + 1
                 cur = seen.get(st["name"])
                 if cur is None or st.get("created", 0) < cur.get(
                         "created", 0):
                     seen[st["name"]] = st
-        return sorted(seen.values(), key=lambda s: s["name"])
+        return sorted(
+            (st for name, st in seen.items()
+             if counts[name] * 2 > responding),
+            key=lambda s: s["name"])
 
     def bucket_exists(self, bucket: str) -> bool:
         """True if any reachable disk has the bucket and no not-found

@@ -303,6 +303,82 @@ class Healer:
         return results
 
 
+class NewDiskMonitor:
+    """Detects freshly replaced (wiped) disks and auto-triggers the
+    full heal sweep onto them (ref monitorLocalDisksAndHeal,
+    cmd/background-newdisks-heal-ops.go:113: the reference watches for
+    disks carrying a healing tracker written at fresh format).
+
+    Freshness signal here: a reachable disk that is missing bucket
+    volumes the rest of the set agrees on — exactly the state a swapped
+    drive is in. Object-level drift on a disk that has all volumes is
+    the scanner's heal-sampling job, not this monitor's."""
+
+    def __init__(self, healer: Healer, interval: float = 10.0):
+        self.healer = healer
+        self.interval = interval
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        # Disks already swept this incarnation; cleared when the disk
+        # goes missing again (so a re-replacement re-triggers).
+        self._healed: set[int] = set()
+        self.sweeps = 0   # observability: completed auto-sweeps
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="newdisk-monitor")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.tick()
+            except Exception:
+                import logging
+                logging.getLogger("minio_tpu.heal").exception(
+                    "new-disk monitor tick failed")
+
+    def tick(self) -> list[int]:
+        """One detection pass; returns indices of disks swept."""
+        eng = self.healer.engine
+        buckets = [b["name"] for b in eng.list_buckets()]
+        if not buckets:
+            return []
+        swept = []
+        for i, disk in enumerate(eng.disks):
+            try:
+                vols = set(disk.list_volumes())
+            except Exception:
+                # Unreachable: not fresh — but forget its healed mark
+                # so its eventual replacement is re-swept.
+                self._healed.discard(i)
+                continue
+            missing = [b for b in buckets if b not in vols]
+            if not missing:
+                # Healthy again: clear the mark so a future
+                # re-replacement counts as fresh.
+                self._healed.discard(i)
+                continue
+            if i in self._healed:
+                continue
+            # heal_disk re-creates missing bucket volumes itself
+            # (heal_bucket per quorum-listed bucket) before sweeping.
+            self.healer.heal_disk(i)
+            self._healed.add(i)
+            self.sweeps += 1
+            swept.append(i)
+        return swept
+
+
 class MRFQueue:
     """Most-recently-failed heal queue: partial PUT failures enqueue the
     object for background healing (ref mrfOpCh, cmd/erasure-object.go:1082

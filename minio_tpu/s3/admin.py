@@ -84,6 +84,16 @@ class Metrics:
                         except Exception:
                             lines.append(
                                 f"minio_tpu_disk_online{{{lbl}}} 0")
+        # Codec dispatch honesty counters: which device actually did the
+        # RS math and the bitrot hashing (ops/batching.STATS/HH_STATS).
+        from ..ops import batching
+        for prefix, stats in (("rs", batching.STATS),
+                              ("bitrot", batching.HH_STATS)):
+            snap = stats.snapshot()
+            for key, val in sorted(snap.items()):
+                lines.append(
+                    f"# TYPE minio_tpu_{prefix}_{key} counter")
+                lines.append(f"minio_tpu_{prefix}_{key} {val}")
         return "\n".join(lines) + "\n"
 
 
@@ -145,9 +155,14 @@ class AdminHandlers:
                              "data": es.k, "parity": es.m,
                              "totalBytes": total, "freeBytes": free})
             pools.append({"sets": sets})
+        from ..ops import batching
         return {"version": __version__, "mode": "erasure",
                 "pools": pools,
-                "uptime": time.time() - self.server.metrics.start_time}
+                "uptime": time.time() - self.server.metrics.start_time,
+                # Device-vs-host dispatch honesty counters for the two
+                # halves of the TPU data plane (RS coding + bitrot).
+                "tpu": {"rs": batching.STATS.snapshot(),
+                        "bitrot": batching.HH_STATS.snapshot()}}
 
     def h_datausage(self, p, body):
         # Serve the crawler's persisted cache when scanning runs

@@ -55,6 +55,11 @@ def test_metrics_exposition(setup):
     assert "minio_tpu_errors_total" in text
     assert "minio_tpu_disk_online" in text
     assert "minio_tpu_uptime_seconds" in text
+    # Codec dispatch honesty counters (RS + bitrot halves of the TPU
+    # data plane) are operator-visible.
+    assert "minio_tpu_rs_tpu_dispatches" in text
+    assert "minio_tpu_rs_cpu_dispatches" in text
+    assert "minio_tpu_bitrot_tpu_dispatches" in text
 
 
 def test_admin_info_and_users(setup):

@@ -127,6 +127,79 @@ def test_heal_fresh_disk_full_sweep(tmp_path):
         assert os.path.exists(os.path.join(wiped, "b", name, "xl.meta"))
 
 
+def test_new_disk_monitor_auto_sweeps(tmp_path):
+    """A wiped disk is detected (missing bucket volumes) and swept
+    without any operator action (ref monitorLocalDisksAndHeal)."""
+    e = make_engine(tmp_path, n=4, block_size=4096)
+    e.make_bucket("b")
+    payloads = {f"o{i}": os.urandom(5000 + i) for i in range(3)}
+    for name, p in payloads.items():
+        e.put_object("b", name, p)
+
+    mon = e.new_disk_monitor
+    assert mon.tick() == []          # healthy set: nothing to do
+
+    wiped = e.disks[2].root
+    shutil.rmtree(wiped)
+    os.makedirs(wiped)
+    assert mon.tick() == [2]         # fresh disk detected + swept
+    assert mon.sweeps == 1
+    for name in payloads:
+        assert os.path.exists(os.path.join(wiped, "b", name, "xl.meta"))
+    assert mon.tick() == []          # idempotent: no re-sweep
+
+    # Re-replacement (volume vanishes again) re-triggers.
+    shutil.rmtree(wiped)
+    os.makedirs(wiped)
+    assert mon.tick() == [2]
+    assert mon.sweeps == 2
+
+
+def test_deleted_bucket_not_resurrected_by_stale_disk(tmp_path):
+    """A bucket deleted at write quorum while one disk was offline must
+    NOT reappear (in listings or via the new-disk monitor) when the
+    stale disk rejoins — majority list_buckets semantics."""
+    e = make_engine(tmp_path, n=4, naughty=True, block_size=4096)
+    e.make_bucket("keep")
+    e.make_bucket("gone")
+    e.put_object("keep", "o", os.urandom(3000))
+    e.disks[3].offline = True
+    e.delete_bucket("gone")          # succeeds at quorum (3/4)
+    e.disks[3].offline = False       # stale copy of "gone" rejoins
+    assert [b["name"] for b in e.list_buckets()] == ["keep"]
+    # The monitor must not treat disks 0-2 as fresh (they're missing
+    # nothing the quorum agrees on) nor recreate "gone" anywhere.
+    assert e.new_disk_monitor.tick() == []
+    for i in range(3):
+        assert not os.path.isdir(
+            os.path.join(e.disks[i].inner.root, "gone"))
+
+
+def test_coalescer_lone_small_request_fast_path():
+    """A lone sub-threshold encode is declined without waiting the
+    full coalescing window (round-3 verdict weak #6)."""
+    import time
+
+    import numpy as np
+
+    from minio_tpu.ops.batching import EncodeCoalescer, host_encode
+
+    calls = []
+    co = EncodeCoalescer(lambda n: calls.append(n) or False,
+                         window_s=0.25)
+    blocks = np.arange(4 * 2 * 64, dtype=np.uint8).reshape(1, 8, 64)
+    t0 = time.perf_counter()
+    out = co.encode(blocks[:, :4, :32], 4, 2)
+    dt = time.perf_counter() - t0
+    co.stop()
+    assert calls, "policy must have been consulted"
+    assert out.shape == (1, 6, 32)
+    want = host_encode(blocks[:, :4, :32].copy(), 4, 2)
+    np.testing.assert_array_equal(out, want)
+    # Well under the 250ms window proves the fast path skipped it.
+    assert dt < 0.2, f"lone request waited the window: {dt:.3f}s"
+
+
 def test_mrf_heals_partial_write(tmp_path):
     """A PUT with one failed disk self-heals via the MRF queue."""
     e = make_engine(tmp_path, n=4, naughty=True, block_size=4096)
