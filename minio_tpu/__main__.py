@@ -232,6 +232,18 @@ def _serve(args) -> int:
             layer = _maybe_wrap_cache(node.layer)
             server.set_layer(layer)
             server.iam = _make_iam(node.layer, access, secret)
+            # Peer control plane: bind the RPC service to this server
+            # and wire push invalidation — the 1s freshness polls
+            # become slow safety nets (ref NotificationSys,
+            # cmd/notification.go:48).
+            node.peer_service.bind(server)
+            server.notification = node.notification
+            server.iam.notify = node.notification.load_iam
+            server.iam.reload_interval = 30.0
+            server.bucket_meta.notify_update = \
+                node.notification.load_bucket_metadata
+            server.bucket_meta.notify_delete = \
+                node.notification.delete_bucket_metadata
         else:
             layer = _maybe_wrap_cache(
                 build_object_layer(args.disks, args.block_size))

@@ -74,6 +74,12 @@ class BucketMetadataSys:
         self.store = store
         self._mu = threading.RLock()
         self._cache: dict[str, tuple[float, BucketMetadata]] = {}
+        # Peer push: set to NotificationSys.load_bucket_metadata /
+        # delete_bucket_metadata in distributed mode so other nodes
+        # drop their cache immediately instead of waiting out CACHE_TTL
+        # (ref peerRESTMethodLoadBucketMetadata).
+        self.notify_update = None
+        self.notify_delete = None
 
     @classmethod
     def for_layer(cls, layer) -> "BucketMetadataSys":
@@ -108,10 +114,18 @@ class BucketMetadataSys:
             self._cache[bucket] = (time.time(), meta)
         return meta
 
+    def invalidate(self, bucket: str) -> None:
+        """Drop the cache entry (peer-push target: next get() re-reads
+        the quorum-stored document)."""
+        with self._mu:
+            self._cache.pop(bucket, None)
+
     def save(self, meta: BucketMetadata) -> None:
         self.store.save(self._path(meta.name), meta.to_dict())
         with self._mu:
             self._cache[meta.name] = (time.time(), meta)
+        if self.notify_update is not None:
+            self.notify_update(meta.name)
 
     def update(self, bucket: str, **fields) -> BucketMetadata:
         """Atomic read-modify-write of one or more config sections: the
@@ -126,12 +140,16 @@ class BucketMetadataSys:
             meta.name = bucket
             self.store.save(self._path(bucket), meta.to_dict())
             self._cache[bucket] = (time.time(), meta)
+        if self.notify_update is not None:
+            self.notify_update(bucket)
         return meta
 
     def delete(self, bucket: str) -> None:
         self.store.delete(self._path(bucket))
         with self._mu:
             self._cache.pop(bucket, None)
+        if self.notify_delete is not None:
+            self.notify_delete(bucket)
 
     # -- convenience ----------------------------------------------------
 

@@ -114,35 +114,59 @@ class IAMSys:
         self._sts_key = hashlib.sha256(
             f"sts:{root_secret}".encode()).digest()
         self._last_load = 0.0
+        # Fallback freshness poll (seconds). With the peer push wired
+        # (distributed mode), the boot path stretches this: pushes are
+        # the primary mechanism, the poll is the safety net (ref
+        # peer-notified IAM reload, cmd/notification.go LoadUser etc).
+        self.reload_interval = 1.0
+        # NotificationSys.load_iam in distributed mode; None otherwise.
+        self.notify = None
         self.load()
 
     def _maybe_reload(self) -> None:
         """On-demand refresh so identities created via another cluster
         node become visible (ref peer-notified IAM reload; here a cheap
         miss-triggered re-read with rate limiting)."""
-        if time.time() - self._last_load >= 1.0:
+        if time.time() - self._last_load >= self.reload_interval:
             self.load()
 
     # -- persistence ----------------------------------------------------
 
     def load(self) -> None:
+        """Full rebuild from the store — REPLACE, don't merge, so
+        entities deleted on another node disappear here too (a merge
+        would keep revoked credentials alive until restart; all
+        identities including STS temp creds are store-persisted, so a
+        rebuild loses nothing)."""
         with self._mu:
             self._last_load = time.time()
+            users: dict[str, UserIdentity] = {}
             for name in self.store.list(f"{IAM_PREFIX}/users"):
                 doc = self.store.load(f"{IAM_PREFIX}/users/{name}")
                 if doc:
                     u = UserIdentity.from_dict(doc)
-                    self.users[u.access_key] = u
+                    users[u.access_key] = u
+            policies = dict(DEFAULT_POLICIES)
+            policy_docs: dict[str, dict] = {}
             for name in self.store.list(f"{IAM_PREFIX}/policies"):
                 doc = self.store.load(f"{IAM_PREFIX}/policies/{name}")
                 if doc:
                     pname = name.removesuffix(".json")
-                    self.policies[pname] = Policy.from_dict(doc)
-                    self.policy_docs[pname] = doc
+                    policies[pname] = Policy.from_dict(doc)
+                    policy_docs[pname] = doc
+            groups: dict[str, dict] = {}
             for name in self.store.list(f"{IAM_PREFIX}/groups"):
                 doc = self.store.load(f"{IAM_PREFIX}/groups/{name}")
                 if doc:
-                    self.groups[name.removesuffix(".json")] = doc
+                    groups[name.removesuffix(".json")] = doc
+            self.users = users
+            self.policies = policies
+            self.policy_docs = policy_docs
+            self.groups = groups
+
+    def _notify_peers(self) -> None:
+        if self.notify is not None:
+            self.notify()
 
     # -- users ----------------------------------------------------------
 
@@ -158,6 +182,7 @@ class IAMSys:
             self.users[access_key] = u
             self.store.save(f"{IAM_PREFIX}/users/{access_key}.json",
                             u.to_dict())
+        self._notify_peers()
         return u
 
     def remove_user(self, access_key: str) -> None:
@@ -166,6 +191,7 @@ class IAMSys:
                 raise KeyError(access_key)
             del self.users[access_key]
             self.store.delete(f"{IAM_PREFIX}/users/{access_key}.json")
+        self._notify_peers()
 
     def set_user_status(self, access_key: str, status: str) -> None:
         with self._mu:
@@ -173,6 +199,7 @@ class IAMSys:
             u.status = status
             self.store.save(f"{IAM_PREFIX}/users/{access_key}.json",
                             u.to_dict())
+        self._notify_peers()
 
     def set_user_policy(self, access_key: str,
                         policies: list[str]) -> None:
@@ -181,6 +208,7 @@ class IAMSys:
             u.policies = list(policies)
             self.store.save(f"{IAM_PREFIX}/users/{access_key}.json",
                             u.to_dict())
+        self._notify_peers()
 
     def list_users(self) -> list[dict]:
         with self._mu:
@@ -205,6 +233,7 @@ class IAMSys:
                     u.groups.append(name)
                     self.store.save(f"{IAM_PREFIX}/users/{m}.json",
                                     u.to_dict())
+        self._notify_peers()
 
     # -- policies -------------------------------------------------------
 
@@ -213,6 +242,7 @@ class IAMSys:
             self.policies[name] = Policy.from_dict(doc)
             self.policy_docs[name] = doc
             self.store.save(f"{IAM_PREFIX}/policies/{name}.json", doc)
+        self._notify_peers()
 
     def delete_policy(self, name: str) -> None:
         with self._mu:
@@ -221,6 +251,7 @@ class IAMSys:
             self.policies.pop(name, None)
             self.policy_docs.pop(name, None)
             self.store.delete(f"{IAM_PREFIX}/policies/{name}.json")
+        self._notify_peers()
 
     def list_policies(self) -> list[str]:
         with self._mu:

@@ -86,15 +86,19 @@ def derive_cluster_key(access_key: str, secret_key: str) -> bytes:
 
 class ClusterNode:
     """Everything one node contributes: its object layer, its RPC
-    services (local disks + locker), and peer clients."""
+    services (local disks + locker + peer control plane), and peer
+    clients."""
 
     def __init__(self, layer: ErasureServerPools, registry: RPCRegistry,
                  local_disks: dict[str, XLStorage],
-                 peers: dict[str, RPCClient]):
+                 peers: dict[str, RPCClient],
+                 peer_service=None, notification=None):
         self.layer = layer
         self.registry = registry
         self.local_disks = local_disks
         self.peers = peers
+        self.peer_service = peer_service    # rpc.peer.PeerRPCService
+        self.notification = notification    # rpc.peer.NotificationSys
 
 
 def build_cluster_node(disk_args: list[str], my_host: str, my_port: int,
@@ -141,12 +145,20 @@ def build_cluster_node(disk_args: list[str], my_host: str, my_port: int,
     pool_disks = [[realize(ep) for ep in eps] for eps in pool_endpoints]
 
     # Register services FIRST — the format wait below depends on peers
-    # being able to call us, and us them.
+    # being able to call us, and us them. The peer service must answer
+    # handshakes before this node finishes booting (ref
+    # bootstrap-peer-server registering ahead of waitForFormatErasure).
+    from .peer import NotificationSys, PeerRPCService, topology_hash
+    topo = topology_hash(sorted(
+        f"{ep.host}:{ep.port}{ep.path}" if ep.is_url else ep.path
+        for eps in pool_endpoints for ep in eps))
+    peer_service = PeerRPCService(topo)
     locker = LocalLocker()
     if registry is None:
         registry = RPCRegistry(cluster_key)
     registry.register("lock", LockRPCService(locker))
     registry.register("storage", StorageRPCService(local_disks))
+    registry.register("peer", peer_service)
 
     all_nodes: set[str] = set()
     my_keys = {f"{h}:{my_port}" for h in my_hosts}
@@ -161,6 +173,18 @@ def build_cluster_node(disk_args: list[str], my_host: str, my_port: int,
             lock_clients.append(_RemoteLockerClient(peers.setdefault(
                 key, RPCClient(key.rsplit(":", 1)[0],
                                int(key.rsplit(":", 1)[1]), cluster_key))))
+
+    # Peer control plane shares the lock/storage RPC clients (the
+    # setdefault loop above guarantees one per remote node).
+    notification = NotificationSys(
+        {k: c for k, c in peers.items() if k not in my_keys})
+
+    # Bootstrap verify BEFORE joining the format dance: refuse peers
+    # that disagree on version/protocol/topology (ref
+    # cmd/bootstrap-peer-server.go:162, cmd/server-main.go:469-483).
+    # Peers not yet answering (still booting) verify us when they do.
+    if distributed:
+        notification.verify_bootstrap(topo)
 
     kwargs = {}
     if block_size:
@@ -198,7 +222,9 @@ def build_cluster_node(disk_args: list[str], my_host: str, my_port: int,
         pools.append(sets)
 
     layer = ErasureServerPools(pools)
-    return ClusterNode(layer, registry, local_disks, peers)
+    return ClusterNode(layer, registry, local_disks, peers,
+                       peer_service=peer_service,
+                       notification=notification)
 
 
 def _try_load(disk) -> FormatErasure | None:

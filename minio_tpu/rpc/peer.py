@@ -1,0 +1,240 @@
+"""Peer control plane: node-to-node notifications, cluster-wide admin
+fan-in, and the boot handshake.
+
+The reference fans ~35 methods across peers (ref cmd/notification.go:48
+NotificationSys, cmd/peer-rest-common.go:27-61 method list) and refuses
+mismatched nodes at boot (ref cmd/bootstrap-peer-server.go:162
+verifyServerSystemConfig, cmd/server-main.go:469-483). This rebuild
+keeps the same responsibilities on the existing HMAC RPC transport
+(rpc/transport.py), with the set of methods the rest of the codebase
+actually consumes:
+
+  handshake               boot-time version/topology verification
+  load_iam                push IAM invalidation (replaces cross-node
+                          freshness polling as the primary mechanism)
+  load_bucket_metadata /  push bucket-config invalidation
+  delete_bucket_metadata
+  trace                   bounded trace collection for cluster-wide
+                          `admin trace` (ref peerRESTMethodTrace)
+  profiling_start/stop    cluster-wide CPU profiling fan-out
+  metrics                 per-node codec dispatch + request counters
+  server_info             per-node admin info for cluster aggregation
+
+Fan-out is parallel and failure-tolerant: an unreachable peer degrades
+that node's freshness to its fallback poll, never the caller's request.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+
+from .. import __version__
+from .transport import RPCClient
+
+PROTOCOL_VERSION = 1
+
+
+def topology_hash(disk_args_expanded: list[str]) -> str:
+    """Deterministic digest of the cluster shape every node must agree
+    on (the reference compares endpoint ordering, CmdLine and version
+    in verifyServerSystemConfig)."""
+    doc = "\n".join(disk_args_expanded)
+    return hashlib.sha256(doc.encode()).hexdigest()
+
+
+class PeerRPCService:
+    """Server side of the peer control plane. Constructed (and
+    registered on the RPC registry) before the S3 server has a layer —
+    handshake works immediately; server-backed methods bind later via
+    bind()."""
+
+    def __init__(self, topo_hash: str):
+        self.topo_hash = topo_hash
+        self.server = None          # S3Server, set by bind()
+        self._profiler = None
+
+    def bind(self, server) -> None:
+        self.server = server
+
+    # -- bootstrap -----------------------------------------------------
+
+    def rpc_handshake(self, args: dict, payload: bytes):
+        return ({"version": __version__, "protocol": PROTOCOL_VERSION,
+                 "topology": self.topo_hash}, b"")
+
+    # -- invalidation pushes -------------------------------------------
+
+    def _server(self):
+        if self.server is None:
+            raise RuntimeError("peer service not bound yet")
+        return self.server
+
+    def rpc_load_iam(self, args: dict, payload: bytes):
+        iam = self._server().iam
+        if iam is not None:
+            iam.load()
+        return ({"ok": True}, b"")
+
+    def rpc_load_bucket_metadata(self, args: dict, payload: bytes):
+        self._server().bucket_meta.invalidate(args["bucket"])
+        return ({"ok": True}, b"")
+
+    def rpc_delete_bucket_metadata(self, args: dict, payload: bytes):
+        self._server().bucket_meta.invalidate(args["bucket"])
+        return ({"ok": True}, b"")
+
+    # -- cluster-wide admin fan-in -------------------------------------
+
+    def rpc_trace(self, args: dict, payload: bytes):
+        """Bounded trace collect, same contract as admin h_trace."""
+        timeout = min(float(args.get("timeout", 3)), 30.0)
+        entries = self._server().trace_hub.collect(timeout)
+        return ({"entries": entries}, b"")
+
+    def rpc_profiling_start(self, args: dict, payload: bytes):
+        from ..utils.profiler import SamplingProfiler
+        if self._profiler is not None:
+            raise ValueError("profiling already running")
+        self._profiler = SamplingProfiler(
+            interval=float(args.get("intervalMs", 5)) / 1000.0)
+        self._profiler.start()
+        return ({"ok": True}, b"")
+
+    def rpc_profiling_stop(self, args: dict, payload: bytes):
+        prof = self._profiler
+        if prof is None:
+            raise ValueError("profiling not running")
+        self._profiler = None
+        return ({"profile": prof.stop()}, b"")
+
+    def rpc_metrics(self, args: dict, payload: bytes):
+        from ..ops import batching
+        srv = self._server()
+        return ({"rs": batching.STATS.snapshot(),
+                 "bitrot": batching.HH_STATS.snapshot(),
+                 "requests": dict(srv.metrics.requests),
+                 "rx_bytes": srv.metrics.rx_bytes,
+                 "tx_bytes": srv.metrics.tx_bytes}, b"")
+
+    def rpc_server_info(self, args: dict, payload: bytes):
+        srv = self._server()
+        return ({"version": __version__,
+                 "uptime": __import__("time").time()
+                 - srv.metrics.start_time,
+                 "endpoint": f"{srv.host}:{srv.port}"
+                 if hasattr(srv, "host") else ""}, b"")
+
+
+class BootstrapMismatch(RuntimeError):
+    """A peer disagrees about version/protocol/topology — refusing to
+    join (ref bootstrap verify error, cmd/server-main.go:469-483)."""
+
+
+class NotificationSys:
+    """Client side: parallel fan-out to every peer (ref NotificationSys,
+    cmd/notification.go:48). All pushes are fire-and-forget from the
+    caller's perspective — failures degrade the peer to its fallback
+    poll and are reported in the return value for tests/observability."""
+
+    def __init__(self, peers: dict[str, RPCClient]):
+        self.peers = dict(peers)
+
+    def _fanout(self, method: str, args: dict,
+                timeout: float | None = None,
+                ) -> dict[str, dict | Exception]:
+        results: dict[str, dict | Exception] = {}
+        if not self.peers:
+            return results
+
+        def one(key: str, client: RPCClient) -> None:
+            try:
+                results[key], _ = client.call("peer", method, args,
+                                              timeout=timeout)
+            except Exception as exc:  # noqa: BLE001 - per-peer failure
+                results[key] = exc
+
+        threads = [threading.Thread(target=one, args=kv, daemon=True)
+                   for kv in self.peers.items()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return results
+
+    def _fanout_async(self, method: str, args: dict) -> None:
+        """Push without blocking the mutating request on peer RPCs."""
+        threading.Thread(target=self._fanout, args=(method, args),
+                         daemon=True).start()
+
+    # -- bootstrap -----------------------------------------------------
+
+    def verify_bootstrap(self, topo_hash: str) -> dict[str, str]:
+        """Handshake every reachable peer; BootstrapMismatch on any
+        disagreement. Unreachable peers are skipped (they verify us
+        when they boot; the reference retries until the cluster
+        converges). Returns {peer: status} for logging."""
+        statuses: dict[str, str] = {}
+        for key, res in self._fanout("handshake", {}).items():
+            if isinstance(res, Exception):
+                statuses[key] = f"unreachable: {res}"
+                continue
+            if res.get("protocol") != PROTOCOL_VERSION:
+                raise BootstrapMismatch(
+                    f"peer {key} speaks protocol {res.get('protocol')}, "
+                    f"this node {PROTOCOL_VERSION}")
+            if res.get("version") != __version__:
+                raise BootstrapMismatch(
+                    f"peer {key} runs version {res.get('version')}, "
+                    f"this node {__version__}")
+            if res.get("topology") != topo_hash:
+                raise BootstrapMismatch(
+                    f"peer {key} has a different endpoint topology "
+                    f"({res.get('topology', '')[:12]}... vs "
+                    f"{topo_hash[:12]}...) — same endpoint list "
+                    "required on every node")
+            statuses[key] = "ok"
+        return statuses
+
+    # -- pushes --------------------------------------------------------
+
+    def load_iam(self) -> None:
+        self._fanout_async("load_iam", {})
+
+    def load_bucket_metadata(self, bucket: str) -> None:
+        self._fanout_async("load_bucket_metadata", {"bucket": bucket})
+
+    def delete_bucket_metadata(self, bucket: str) -> None:
+        self._fanout_async("delete_bucket_metadata", {"bucket": bucket})
+
+    # -- synchronous fan-ins (admin aggregation) -----------------------
+
+    def trace_all(self, timeout: float) -> list:
+        entries = []
+        # The peer blocks up to `timeout` by design: give the RPC its
+        # own window instead of the data plane's self-tuning one.
+        for res in self._fanout("trace", {"timeout": timeout},
+                                timeout=timeout + 10).values():
+            if isinstance(res, dict):
+                entries.extend(res.get("entries", []))
+        return entries
+
+    def profiling_start_all(self, interval_ms: float) -> dict:
+        return {k: (str(v) if isinstance(v, Exception) else "ok")
+                for k, v in self._fanout(
+                    "profiling_start",
+                    {"intervalMs": interval_ms}).items()}
+
+    def profiling_stop_all(self) -> dict:
+        out = {}
+        for k, v in self._fanout("profiling_stop", {}).items():
+            out[k] = v.get("profile") if isinstance(v, dict) else str(v)
+        return out
+
+    def metrics_all(self) -> dict:
+        return {k: (v if isinstance(v, dict) else {"error": str(v)})
+                for k, v in self._fanout("metrics", {}).items()}
+
+    def server_info_all(self) -> dict:
+        return {k: (v if isinstance(v, dict) else {"error": str(v)})
+                for k, v in self._fanout("server_info", {}).items()}

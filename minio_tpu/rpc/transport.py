@@ -115,8 +115,10 @@ class RPCClient:
     def timeout(self) -> float:
         return self.dyn_timeout.timeout
 
-    def _get_conn(self) -> http.client.HTTPConnection:
-        t = self.timeout
+    def _get_conn(self, t: float | None = None,
+                  ) -> http.client.HTTPConnection:
+        if t is None:
+            t = self.timeout
         with self._mu:
             if self._pool:
                 conn = self._pool.pop()
@@ -135,8 +137,15 @@ class RPCClient:
         conn.close()
 
     def call(self, service: str, method: str, args: dict,
-             payload: bytes = b"") -> tuple[dict, bytes]:
-        """Returns (result_json, body_bytes); raises storage errors."""
+             payload: bytes = b"",
+             timeout: float | None = None) -> tuple[dict, bytes]:
+        """Returns (result_json, body_bytes); raises storage errors.
+
+        `timeout` overrides the self-tuning data-plane timeout for
+        calls that legitimately block server-side (e.g. a 3-30s trace
+        long-poll) — such calls neither tune the dynamic timeout nor
+        mark the peer offline on expiry, so a slow control-plane poll
+        can never knock a healthy peer out of the data plane."""
         if not self.is_online():
             raise serr.DiskNotFound(f"{self.endpoint()} offline")
         args_json = json.dumps(args, sort_keys=True)
@@ -148,15 +157,17 @@ class RPCClient:
                                 ts, args_json, payload),
             "Content-Length": str(len(body)),
         }
-        conn = self._get_conn()
+        override = timeout is not None
+        conn = self._get_conn(timeout)
         t0 = time.monotonic()
-        logged = False
+        logged = override
         try:
             conn.request("POST", f"{RPC_PREFIX}/{service}/{method}",
                          body=body, headers=headers)
             resp = conn.getresponse()
             rbody = resp.read()
-            self.dyn_timeout.log_success(time.monotonic() - t0)
+            if not override:
+                self.dyn_timeout.log_success(time.monotonic() - t0)
             logged = True
             if resp.status != 200:
                 self._put_conn(conn)
@@ -171,7 +182,8 @@ class RPCClient:
             if not logged and isinstance(e, (TimeoutError,
                                              socket.timeout)):
                 self.dyn_timeout.log_failure()
-            self._mark_offline()
+            if not override:
+                self._mark_offline()
             raise serr.DiskNotFound(
                 f"{self.endpoint()} unreachable: {e}")
 

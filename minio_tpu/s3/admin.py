@@ -156,13 +156,17 @@ class AdminHandlers:
                              "totalBytes": total, "freeBytes": free})
             pools.append({"sets": sets})
         from ..ops import batching
-        return {"version": __version__, "mode": "erasure",
-                "pools": pools,
-                "uptime": time.time() - self.server.metrics.start_time,
-                # Device-vs-host dispatch honesty counters for the two
-                # halves of the TPU data plane (RS coding + bitrot).
-                "tpu": {"rs": batching.STATS.snapshot(),
-                        "bitrot": batching.HH_STATS.snapshot()}}
+        out = {"version": __version__, "mode": "erasure",
+               "pools": pools,
+               "uptime": time.time() - self.server.metrics.start_time,
+               # Device-vs-host dispatch honesty counters for the two
+               # halves of the TPU data plane (RS coding + bitrot).
+               "tpu": {"rs": batching.STATS.snapshot(),
+                       "bitrot": batching.HH_STATS.snapshot()}}
+        notif = self.server.notification
+        if notif is not None:
+            out["peers"] = notif.server_info_all()
+        return out
 
     def h_datausage(self, p, body):
         # Serve the crawler's persisted cache when scanning runs
@@ -435,14 +439,24 @@ class AdminHandlers:
         self._profiler = SamplingProfiler(
             interval=float(p.get("intervalMs", "5")) / 1000.0)
         self._profiler.start()
-        return {"ok": True}
+        out = {"ok": True}
+        notif = self.server.notification
+        if p.get("cluster") == "true" and notif is not None:
+            # Cluster-wide profiling (ref peerRESTMethodStartProfiling).
+            out["peers"] = notif.profiling_start_all(
+                float(p.get("intervalMs", "5")))
+        return out
 
     def h_profiling_stop(self, p, body):
         prof = getattr(self, "_profiler", None)
         if prof is None:
             raise ValueError("profiling not running")
         self._profiler = None
-        return {"profile": prof.stop()}
+        out = {"profile": prof.stop()}
+        notif = self.server.notification
+        if p.get("cluster") == "true" and notif is not None:
+            out["peers"] = notif.profiling_stop_all()
+        return out
 
     # -- bandwidth (ref pkg/bandwidth, admin /bandwidth route,
     # cmd/admin-router.go:217) -----------------------------------------
@@ -526,22 +540,27 @@ class AdminHandlers:
         """Long-poll: subscribe to the request-trace hub and collect
         entries for up to `timeout` seconds (default 3, cap 30). The
         reference streams indefinitely over chunked HTTP; a bounded
-        collect keeps the admin API request/response."""
-        import queue as _queue
+        collect keeps the admin API request/response.
+
+        cluster=true additionally collects from every peer over the
+        same window (ref peerRESTMethodTrace fan-in,
+        cmd/admin-router.go:199)."""
+        import threading as _threading
         timeout = min(float(p.get("timeout", "3") or 3), 30.0)
-        hub = self.server.trace_hub
-        q = hub.subscribe()
-        entries = []
-        deadline = time.time() + timeout
-        try:
-            while time.time() < deadline and len(entries) < 10_000:
-                try:
-                    entries.append(q.get(
-                        timeout=max(0.01, deadline - time.time())))
-                except _queue.Empty:
-                    break
-        finally:
-            hub.unsubscribe(q)
+        notif = self.server.notification
+        peer_entries: list = []
+        collector = None
+        if p.get("cluster") == "true" and notif is not None:
+            collector = _threading.Thread(
+                target=lambda: peer_entries.extend(
+                    notif.trace_all(timeout)), daemon=True)
+            collector.start()
+        entries = self.server.trace_hub.collect(timeout)
+        if collector is not None:
+            collector.join(timeout=timeout + 5)
+            entries.extend(peer_entries)
+            entries.sort(key=lambda e: e.get("time", 0)
+                         if isinstance(e, dict) else 0)
         return {"entries": entries}
 
     def h_console_log(self, p, body):

@@ -1911,6 +1911,9 @@ class S3Server:
             self.audit = AuditWebhook.from_env()
             self._audit_from_env = self.audit is not None
         self.crawler = None  # attached by serve when scanning is on
+        # rpc.peer.NotificationSys in distributed mode: admin trace /
+        # profiling / info aggregate across the cluster through it.
+        self.notification = None
         # PUT bodies at or above this size stream through the engine's
         # block pipeline instead of buffering (O(batch) server memory).
         self.stream_threshold = 8 * 1024 * 1024

@@ -33,6 +33,25 @@ class PubSub:
             self._subs.append(q)
         return q
 
+    def collect(self, timeout: float, cap: int = 10_000) -> list:
+        """Subscribe, gather entries for up to `timeout` seconds (or
+        until `cap`), unsubscribe — the bounded long-poll behind both
+        the local admin trace API and the peer trace RPC."""
+        import time as _time
+        q = self.subscribe()
+        entries: list = []
+        deadline = _time.time() + timeout
+        try:
+            while _time.time() < deadline and len(entries) < cap:
+                try:
+                    entries.append(q.get(
+                        timeout=max(0.01, deadline - _time.time())))
+                except queue.Empty:
+                    break
+        finally:
+            self.unsubscribe(q)
+        return entries
+
     def unsubscribe(self, q: queue.Queue) -> None:
         with self._mu:
             try:

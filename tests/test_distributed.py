@@ -198,3 +198,138 @@ def test_dist_lock_over_rpc(cluster):
         order.append("n1-acquired")
     t.join()
     assert order == ["n0-acquired", "n0-released", "n1-acquired"]
+
+
+# --- peer control plane (ref cmd/notification.go, bootstrap verify) ---------
+
+
+def _wire_peer_plane(servers, nodes):
+    """What __main__ does in distributed mode: bind peer services and
+    route invalidation pushes through NotificationSys."""
+    from minio_tpu.iam.iam import ConfigStore, IAMSys
+    for (srv, _reg), node in zip(servers, nodes):
+        if srv.iam is None:
+            disks = node.layer.pools[0].sets[0].disks
+            srv.iam = IAMSys(ConfigStore(disks), ACCESS, SECRET)
+        node.peer_service.bind(srv)
+        srv.notification = node.notification
+        srv.iam.notify = node.notification.load_iam
+        srv.iam.reload_interval = 1e9   # pushes only: prove the push
+        srv.bucket_meta.notify_update = \
+            node.notification.load_bucket_metadata
+        srv.bucket_meta.notify_delete = \
+            node.notification.delete_bucket_metadata
+
+
+def test_bootstrap_refuses_mismatched_topology(cluster, tmp_path):
+    """A node whose endpoint list disagrees must fail its boot
+    handshake (ref cmd/bootstrap-peer-server.go:162)."""
+    from minio_tpu.rpc.peer import BootstrapMismatch
+    servers, ports, nodes, tmp = cluster
+    # Same live peers, but claim a different disk layout.
+    bad_endpoints = [f"http://127.0.0.1:{p}{tmp}/WRONG/d{d}"
+                     for p in ports for d in (1, 2)]
+    with pytest.raises(BootstrapMismatch, match="topology"):
+        build_cluster_node(bad_endpoints, "127.0.0.1", ports[0] + 0,
+                           ACCESS, SECRET, format_timeout=5.0)
+
+
+def test_bootstrap_handshake_agrees(cluster):
+    servers, ports, nodes, tmp = cluster
+    statuses = nodes[0].notification.verify_bootstrap(
+        nodes[0].peer_service.topo_hash)
+    assert statuses and all(v == "ok" for v in statuses.values())
+
+
+def test_iam_push_invalidation(cluster):
+    """A policy/user change on node A is enforced on node B WITHOUT
+    polling (poll interval pinned effectively-infinite)."""
+    servers, ports, nodes, tmp = cluster
+    _wire_peer_plane(servers, nodes)
+    iam_a = servers[0][0].iam
+    iam_b = servers[1][0].iam
+    iam_b.load()   # fresh baseline, then no polling allowed
+    iam_a.add_user("pushuser", "pushsecret123", policies=["readonly"])
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if "pushuser" in iam_b.users:
+            break
+        time.sleep(0.05)
+    assert "pushuser" in iam_b.users, \
+        "peer push did not propagate the new user"
+    assert iam_b.users["pushuser"].policies == ["readonly"]
+
+
+def test_bucket_metadata_push_invalidation(cluster):
+    servers, ports, nodes, tmp = cluster
+    _wire_peer_plane(servers, nodes)
+    bms_a = servers[0][0].bucket_meta
+    bms_b = servers[1][0].bucket_meta
+    bms_b.CACHE_TTL = 1e9          # pushes only
+    layer = nodes[0].layer
+    try:
+        layer.make_bucket("pushmeta")
+    except Exception:
+        pass
+    bms_b.get("pushmeta")          # warm B's cache (no quota)
+    bms_a.update("pushmeta", quota={"quota": 12345, "quotaType": "hard"})
+    deadline = time.time() + 5
+    got = None
+    while time.time() < deadline:
+        got = bms_b.get("pushmeta").quota
+        if got:
+            break
+        time.sleep(0.05)
+    assert got and got.get("quota") == 12345, \
+        "peer push did not invalidate B's bucket-metadata cache"
+
+
+def test_cluster_trace_fan_in(cluster):
+    """Events published on node B's trace hub surface in node A's
+    cluster-wide trace collection (ref peerRESTMethodTrace)."""
+    servers, ports, nodes, tmp = cluster
+    _wire_peer_plane(servers, nodes)
+
+    def publish():
+        time.sleep(0.2)
+        servers[1][0].trace_hub.publish(
+            {"api": "TEST-remote", "time": time.time()})
+        servers[0][0].trace_hub.publish(
+            {"api": "TEST-local", "time": time.time()})
+
+    t = threading.Thread(target=publish)
+    t.start()
+    out = servers[0][0].admin.h_trace(
+        {"timeout": "1.5", "cluster": "true"}, b"")
+    t.join()
+    apis = {e.get("api") for e in out["entries"] if isinstance(e, dict)}
+    assert "TEST-remote" in apis and "TEST-local" in apis
+
+
+def test_cluster_metrics_fan_in(cluster):
+    servers, ports, nodes, tmp = cluster
+    _wire_peer_plane(servers, nodes)
+    out = nodes[0].notification.metrics_all()
+    assert out, "no peers answered metrics"
+    for v in out.values():
+        assert "rs" in v and "bitrot" in v
+
+
+def test_iam_deletion_propagates(cluster):
+    """remove_user on node A revokes the credential on node B — load()
+    must REBUILD (not merge), or revoked keys stay valid forever."""
+    servers, ports, nodes, tmp = cluster
+    _wire_peer_plane(servers, nodes)
+    iam_a = servers[0][0].iam
+    iam_b = servers[1][0].iam
+    iam_a.add_user("doomed", "doomedsecret1", policies=["readonly"])
+    deadline = time.time() + 5
+    while time.time() < deadline and "doomed" not in iam_b.users:
+        time.sleep(0.05)
+    assert "doomed" in iam_b.users
+    iam_a.remove_user("doomed")
+    deadline = time.time() + 5
+    while time.time() < deadline and "doomed" in iam_b.users:
+        time.sleep(0.05)
+    assert "doomed" not in iam_b.users, \
+        "revoked credential still valid on peer"
