@@ -323,3 +323,166 @@ def test_server_streaming_multipart(s3_server):
     assert r.status == 200, r.body
     g = c.get_object("smp", "big-mp.bin")
     assert g.status == 200 and g.body == part1 + part2
+
+
+# --- transform streaming: SSE-C and compression stay O(batch) ---------------
+
+
+def _ssec_headers(key32: bytes) -> dict:
+    import base64
+    return {
+        "x-amz-server-side-encryption-customer-algorithm": "AES256",
+        "x-amz-server-side-encryption-customer-key":
+            base64.b64encode(key32).decode(),
+        "x-amz-server-side-encryption-customer-key-md5":
+            base64.b64encode(hashlib.md5(key32).digest()).decode(),
+    }
+
+
+def _handler_put_stream(srv, bucket, key, chunks, headers=None,
+                        total=None):
+    """Drive the post-auth PUT handler with a true streaming body reader."""
+    from minio_tpu.s3.server import S3Request
+    from minio_tpu.utils.streams import IterReader
+    total = total if total is not None else sum(len(c) for c in chunks)
+    req = S3Request("PUT", f"/{bucket}/{key}", "",
+                    {k.lower(): v for k, v in (headers or {}).items()},
+                    b"")
+    req.body_stream = IterReader(iter(chunks))
+    req.content_length = total
+    return srv.handlers.put_object(req)
+
+
+def _handler_get_stream(srv, bucket, key, headers=None):
+    """GET via the handler; consume the body iterator in small chunks,
+    returning (response, sha256, length)."""
+    from minio_tpu.s3.server import S3Request
+    req = S3Request("GET", f"/{bucket}/{key}", "",
+                    {k.lower(): v for k, v in (headers or {}).items()},
+                    b"")
+    resp = srv.handlers.get_object(req)
+    h = hashlib.sha256()
+    n = 0
+    body = resp.body
+    if isinstance(body, (bytes, bytearray)):
+        h.update(body)
+        n = len(body)
+    else:
+        for chunk in body:
+            h.update(chunk)
+            n += len(chunk)
+    return resp, h.hexdigest(), n
+
+
+def test_server_streaming_sse_c_memory(s3_server):
+    """64MiB SSE-C PUT + GET through the handler pipeline must stay
+    O(batch): the transform chain streams, never holding the object
+    (round-3 verdict weak #4)."""
+    srv, port = s3_server
+    srv.layer.put_batch_bytes = 1 << 20
+    srv.layer.read_group_bytes = 1 << 20
+    c = _client(port)
+    c.make_bucket("ssec-stream")
+    sse_hdrs = _ssec_headers(b"K" * 32)
+    n_chunks = 64
+    want_sha = _pattern_digest(n_chunks)
+
+    tracemalloc.start()
+    r = _handler_put_stream(srv, "ssec-stream", "enc.bin",
+                            _pattern_chunks(n_chunks), sse_hdrs,
+                            total=n_chunks << 20)
+    _, put_peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert r.status == 200
+
+    tracemalloc.start()
+    resp, got_sha, n = _handler_get_stream(srv, "ssec-stream", "enc.bin",
+                                           sse_hdrs)
+    _, get_peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert resp.status == 200 and n == n_chunks << 20
+    assert got_sha == want_sha
+    assert put_peak < 16 << 20, f"SSE PUT peak {put_peak >> 20}MiB"
+    assert get_peak < 16 << 20, f"SSE GET peak {get_peak >> 20}MiB"
+
+    # Ranged GET decrypts only the covering packages.
+    g = c.get_object("ssec-stream", "enc.bin",
+                     headers={**sse_hdrs, "Range": "bytes=1000000-1999999"})
+    plain = b"".join(_pattern_chunks(n_chunks))
+    assert g.status == 206 and g.body == plain[1_000_000:2_000_000]
+
+
+def test_server_streaming_compression_memory(s3_server, monkeypatch):
+    srv, port = s3_server
+    monkeypatch.setattr(srv.handlers, "compress_enabled", True)
+    srv.layer.put_batch_bytes = 1 << 20
+    srv.layer.read_group_bytes = 1 << 20
+    c = _client(port)
+    c.make_bucket("comp-stream")
+    n = 64 << 20
+    chunks = [b"A" * (1 << 20)] * 64  # maximally compressible
+
+    tracemalloc.start()
+    r = _handler_put_stream(srv, "comp-stream", "big.txt", chunks,
+                            {"content-type": "text/plain"}, total=n)
+    _, put_peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert r.status == 200
+
+    info = srv.layer.get_object_info("comp-stream", "big.txt")
+    assert info.size < n // 4, "object was not stored compressed"
+
+    tracemalloc.start()
+    resp, got_sha, got_n = _handler_get_stream(srv, "comp-stream",
+                                               "big.txt")
+    _, get_peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert resp.status == 200 and got_n == n
+    assert got_sha == hashlib.sha256(b"A" * n).hexdigest()
+    assert put_peak < 16 << 20, f"comp PUT peak {put_peak >> 20}MiB"
+    assert get_peak < 16 << 20, f"comp GET peak {get_peak >> 20}MiB"
+
+    g = c.get_object("comp-stream", "big.txt",
+                     headers={"Range": "bytes=5000000-5999999"})
+    assert g.status == 206 and g.body == b"A" * 1_000_000
+
+
+def test_server_streaming_sse_plus_compression(s3_server, monkeypatch):
+    """Both transforms chained: stored = SSE(compress(plain)); GET
+    streams decrypt -> decompress; bytes roundtrip exactly."""
+    srv, port = s3_server
+    monkeypatch.setattr(srv.handlers, "compress_enabled", True)
+    c = _client(port)
+    c.make_bucket("both-stream")
+    sse_hdrs = _ssec_headers(b"J" * 32)
+    body = (b"hello world, " * 100_000)  # 1.3MB compressible
+    r = c.put_object("both-stream", "doc.txt", body,
+                     headers={**sse_hdrs, "content-type": "text/plain"})
+    assert r.status == 200, r.body
+    g = c.get_object("both-stream", "doc.txt", headers=sse_hdrs)
+    assert g.status == 200 and g.body == body
+    g = c.get_object("both-stream", "doc.txt",
+                     headers={**sse_hdrs, "Range": "bytes=70000-90000"})
+    assert g.status == 206 and g.body == body[70000:90001]
+    # Wrong key still refused.
+    bad = _ssec_headers(b"X" * 32)
+    assert c.get_object("both-stream", "doc.txt", headers=bad).status \
+        in (400, 403)
+
+
+def test_transformed_streaming_put_verifies_length(s3_server):
+    """A truncated SSE streaming PUT must abort, not commit — the
+    transform chain must preserve the inner HashingReader's verify()
+    (review finding: non-Reader transforms silently dropped it)."""
+    srv, port = s3_server
+    sse_hdrs = _ssec_headers(b"Z" * 32)
+    _client(port).make_bucket("trunc-bkt")
+    chunks = [b"x" * (1 << 20)] * 3          # only 3MiB arrive
+    import pytest
+    from minio_tpu.s3.errors import APIError
+    from minio_tpu.erasure.engine import ObjectNotFound
+    with pytest.raises(APIError):
+        _handler_put_stream(srv, "trunc-bkt", "short.bin", chunks,
+                            sse_hdrs, total=8 << 20)  # 8MiB declared
+    with pytest.raises(ObjectNotFound):
+        srv.layer.get_object_info("trunc-bkt", "short.bin")
