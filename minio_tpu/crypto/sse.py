@@ -328,33 +328,19 @@ def iter_decrypt(chunks, object_key: bytes, total_ct: int,
     flag needs the package count). last_pkg bounds a ranged read: the
     iterator stops after it instead of expecting ciphertext through
     the final package."""
+    from ..utils.streams import IterReader, read_exactly
     full = PKG_SIZE + PKG_OVERHEAD
     npkg = max(1, -(-(total_ct - 8) // full))
     stop = npkg if last_pkg is None else min(last_pkg + 1, npkg)
-    aead = None
-    base = b""
-    buf = bytearray()
-    it = iter(chunks)
-
-    def fill(n: int) -> bool:
-        while len(buf) < n:
-            try:
-                buf.extend(next(it))
-            except StopIteration:
-                return len(buf) >= n
-        return True
-
-    if not fill(8):
+    r = IterReader(chunks)
+    base = read_exactly(r, 8)
+    if len(base) < 8:
         raise SSEError("truncated ciphertext stream")
-    base = bytes(buf[:8])
-    del buf[:8]
     aead = AESGCM(object_key)
     i = first_pkg
     while i < stop:
         final = i == npkg - 1
-        have_full = fill(full)
-        pkg = bytes(buf[:full])
-        del buf[:full]
+        pkg = read_exactly(r, full)
         if not pkg and not final:
             raise SSEError("truncated ciphertext stream")
         try:
@@ -363,7 +349,7 @@ def iter_decrypt(chunks, object_key: bytes, total_ct: int,
         except Exception:
             raise SSEError(f"package {i}: authentication failed")
         i += 1
-        if not have_full:
+        if len(pkg) < full:
             break
     if i < stop:
         raise SSEError("truncated ciphertext stream")

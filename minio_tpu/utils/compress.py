@@ -172,32 +172,22 @@ class CompressingReader(_Reader):
 
 
 def _iter_blocks_streaming(chunks):
-    """Like _iter_blocks but over an ITERATOR of stored chunks —
-    O(block) buffering."""
-    buf = bytearray()
-    it = iter(chunks)
-
-    def fill(n: int) -> bool:
-        while len(buf) < n:
-            try:
-                buf.extend(next(it))
-            except StopIteration:
-                return len(buf) >= n
-        return True
-
-    if not fill(4) or bytes(buf[:4]) != MAGIC:
+    """Frame parser over an ITERATOR of stored chunks — O(block)
+    buffering via the shared stream helpers."""
+    from .streams import IterReader, read_exactly
+    r = IterReader(chunks)
+    if read_exactly(r, 4) != MAGIC:
         raise ValueError("bad compression magic")
-    del buf[:4]
     while True:
-        if not fill(9):
-            if buf:
-                raise ValueError("truncated compressed stream")
+        header = read_exactly(r, 9)
+        if not header:
             return
-        flag, usize, csize = struct.unpack_from("<BII", buf, 0)
-        if not fill(9 + csize):
+        if len(header) < 9:
             raise ValueError("truncated compressed stream")
-        payload = bytes(buf[9:9 + csize])
-        del buf[:9 + csize]
+        flag, usize, csize = struct.unpack_from("<BII", header, 0)
+        payload = read_exactly(r, csize)
+        if len(payload) < csize:
+            raise ValueError("truncated compressed stream")
         yield flag, usize, payload
 
 
