@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 import uuid
 from dataclasses import dataclass, field
 
@@ -319,10 +320,17 @@ class NewDiskMonitor:
         self.interval = interval
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
-        # Disks already swept this incarnation; cleared when the disk
-        # goes missing again (so a re-replacement re-triggers).
-        self._healed: set[int] = set()
+        # monotonic time of each disk's last completed sweep. A disk
+        # still missing volumes re-sweeps after a slow-cadence backoff
+        # (a single sweep can partially fail under write-lock
+        # contention; once-ever marking would stall convergence
+        # forever), and is cleared when the disk turns healthy so a
+        # future re-replacement triggers immediately.
+        self._swept: dict[int, float] = {}
         self.sweeps = 0   # observability: completed auto-sweeps
+
+    def _resweep_after(self) -> float:
+        return max(self.interval * 4, 5.0)
 
     def start(self) -> None:
         if self._thread is not None and self._thread.is_alive():
@@ -355,25 +363,33 @@ class NewDiskMonitor:
             return []
         swept = []
         for i, disk in enumerate(eng.disks):
+            # LOCAL disks only (ref monitorLocalDisksAndHeal): a wiped
+            # remote drive is its own node's monitor's job — every
+            # node sweeping the same replacement at once just fights
+            # over write locks.
+            if not hasattr(disk, "root"):
+                continue
             try:
                 vols = set(disk.list_volumes())
             except Exception:
                 # Unreachable: not fresh — but forget its healed mark
                 # so its eventual replacement is re-swept.
-                self._healed.discard(i)
+                self._swept.pop(i, None)
                 continue
             missing = [b for b in buckets if b not in vols]
             if not missing:
                 # Healthy again: clear the mark so a future
                 # re-replacement counts as fresh.
-                self._healed.discard(i)
+                self._swept.pop(i, None)
                 continue
-            if i in self._healed:
+            last = self._swept.get(i)
+            if last is not None and (time.monotonic() - last
+                                     < self._resweep_after()):
                 continue
             # heal_disk re-creates missing bucket volumes itself
             # (heal_bucket per quorum-listed bucket) before sweeping.
             self.healer.heal_disk(i)
-            self._healed.add(i)
+            self._swept[i] = time.monotonic()
             self.sweeps += 1
             swept.append(i)
         return swept

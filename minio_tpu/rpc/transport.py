@@ -116,7 +116,11 @@ class RPCClient:
         return self.dyn_timeout.timeout
 
     def _get_conn(self, t: float | None = None,
-                  ) -> http.client.HTTPConnection:
+                  ) -> tuple[http.client.HTTPConnection, bool]:
+        """(connection, reused): callers retry once on a FRESH socket
+        when a pooled one fails — a peer restart leaves every pooled
+        keep-alive connection stale, and treating that as peer death
+        knocks a healthy node out for OFFLINE_RETRY."""
         if t is None:
             t = self.timeout
         with self._mu:
@@ -125,9 +129,16 @@ class RPCClient:
                 conn.timeout = t  # used on (re)connect
                 if conn.sock is not None:
                     conn.sock.settimeout(t)
-                return conn
+                return conn, True
         return http.client.HTTPConnection(self.host, self.port,
-                                          timeout=t)
+                                          timeout=t), False
+
+    def _drop_pool(self) -> None:
+        """Close every pooled connection (stale after a peer restart)."""
+        with self._mu:
+            pool, self._pool = self._pool, []
+        for c in pool:
+            c.close()
 
     def _put_conn(self, conn: http.client.HTTPConnection) -> None:
         with self._mu:
@@ -158,34 +169,50 @@ class RPCClient:
             "Content-Length": str(len(body)),
         }
         override = timeout is not None
-        conn = self._get_conn(timeout)
-        t0 = time.monotonic()
-        logged = override
-        try:
-            conn.request("POST", f"{RPC_PREFIX}/{service}/{method}",
-                         body=body, headers=headers)
-            resp = conn.getresponse()
-            rbody = resp.read()
-            if not override:
-                self.dyn_timeout.log_success(time.monotonic() - t0)
-            logged = True
-            if resp.status != 200:
+        conn, reused = self._get_conn(timeout)
+        while True:
+            t0 = time.monotonic()
+            logged = override
+            resp = None
+            try:
+                conn.request("POST", f"{RPC_PREFIX}/{service}/{method}",
+                             body=body, headers=headers)
+                resp = conn.getresponse()
+                rbody = resp.read()
+                if not override:
+                    self.dyn_timeout.log_success(time.monotonic() - t0)
+                logged = True
+                if resp.status != 200:
+                    self._put_conn(conn)
+                    raise wire_to_error(resp.status, rbody)
+                result_json, data = unframe(rbody)
                 self._put_conn(conn)
-                raise wire_to_error(resp.status, rbody)
-            result_json, data = unframe(rbody)
-            self._put_conn(conn)
-            return json.loads(result_json or b"{}"), data
-        except (OSError, http.client.HTTPException, ValueError) as e:
-            conn.close()
-            # Only genuine ceiling hits tune the timeout up — an
-            # instant connection-refused says nothing about slowness.
-            if not logged and isinstance(e, (TimeoutError,
-                                             socket.timeout)):
-                self.dyn_timeout.log_failure()
-            if not override:
-                self._mark_offline()
-            raise serr.DiskNotFound(
-                f"{self.endpoint()} unreachable: {e}")
+                return json.loads(result_json or b"{}"), data
+            except (OSError, http.client.HTTPException, ValueError) as e:
+                conn.close()
+                if (reused and resp is None and isinstance(
+                        e, (http.client.RemoteDisconnected,
+                            ConnectionResetError, BrokenPipeError))):
+                    # A stale pooled socket (peer restarted): the error
+                    # arrived BEFORE any response started, on a reused
+                    # keep-alive connection — the signature of a dead
+                    # pool, not a dead peer. Retry ONCE on a fresh
+                    # socket; errors after a response began (or any
+                    # error on a fresh socket) never retry, so an RPC
+                    # the peer may have executed is never re-sent.
+                    self._drop_pool()
+                    conn, reused = self._get_conn(timeout)
+                    continue
+                # Only genuine ceiling hits tune the timeout up — an
+                # instant connection-refused says nothing about
+                # slowness.
+                if not logged and isinstance(e, (TimeoutError,
+                                                 socket.timeout)):
+                    self.dyn_timeout.log_failure()
+                if not override:
+                    self._mark_offline()
+                raise serr.DiskNotFound(
+                    f"{self.endpoint()} unreachable: {e}")
 
     def close(self) -> None:
         with self._mu:
