@@ -314,6 +314,14 @@ def hash_chunks(chunks: np.ndarray, key: bytes = MAGIC_KEY) -> np.ndarray:
     else:
         rem_packet = np.zeros((B, 8), dtype=np.uint32)
     init = _init_state_np(key)
+    # Spread independent chunks across the serving mesh; the hash chain
+    # is per-row, so no cross-device collectives.
+    from . import batching
+    m = batching.serving_mesh()
+    if m is not None and B % m.size == 0:
+        from ..parallel.mesh import rows_sharding
+        words = jax.device_put(words, rows_sharding(m, B, 3))
+        rem_packet = jax.device_put(rem_packet, rows_sharding(m, B, 2))
     out = np.asarray(_hash_chunks_device(words, rem_packet, init,
                                          n_full, rem))
     return out.view(np.uint8).reshape(B, 32)

@@ -69,6 +69,24 @@ def any_decode_bitplane(k: int, m: int, available: tuple[int, ...],
     return gf_matrix_to_bitplane(mat).astype(np.float32), used
 
 
+@lru_cache(maxsize=1024)
+def _placed_parity(k: int, m: int, mesh) -> "jnp.ndarray":
+    """parity_bitplane already cached host-side; this caches the
+    DEVICE-PLACED (mesh-replicated) copy so the hot PUT path doesn't
+    re-transfer the matrix on every dispatch (mesh is hashable; None on
+    a single device)."""
+    from . import batching
+    return batching.device_put_replicated(parity_bitplane(k, m))
+
+
+@lru_cache(maxsize=1024)
+def _placed_any_decode(k: int, m: int, available: tuple[int, ...],
+                       missing: tuple[int, ...], mesh) -> "jnp.ndarray":
+    from . import batching
+    bm, _ = any_decode_bitplane(k, m, available, missing)
+    return batching.device_put_replicated(bm)
+
+
 # --- device kernel ------------------------------------------------------------
 
 
@@ -116,9 +134,16 @@ def encode_blocks(big_m: jnp.ndarray, data: jnp.ndarray) -> jnp.ndarray:
 
 
 def encode_batch(data: np.ndarray, k: int, m: int) -> np.ndarray:
-    """Encode a (B, k, S) or (k, S) uint8 batch on the default device."""
-    bm = jnp.asarray(parity_bitplane(k, m))
-    return np.asarray(encode_blocks(bm, jnp.asarray(data)))
+    """Encode a (B, k, S) or (k, S) uint8 batch on the device(s) —
+    batches spread across the serving mesh when >1 device is visible
+    (ops/batching.device_put_batch)."""
+    from . import batching
+    bm = _placed_parity(k, m, batching.serving_mesh())
+    if data.ndim == 3:
+        placed = batching.device_put_batch(data)
+    else:
+        placed = jnp.asarray(data)
+    return np.asarray(encode_blocks(bm, placed))
 
 
 def reconstruct_batch(shards: np.ndarray, k: int, m: int,

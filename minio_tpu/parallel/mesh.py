@@ -46,5 +46,25 @@ def block_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("blocks", None, "lanes"))
 
 
+def batch_sharding(mesh: Mesh, B: int, S: int) -> NamedSharding:
+    """block_sharding with divisibility fallback: an axis that doesn't
+    divide its mesh dimension stays replicated (serving batches have
+    arbitrary B and tail-block S). Single source of truth for the
+    serving path AND the dryrun demo."""
+    return NamedSharding(mesh, P(
+        "blocks" if B % mesh.shape["blocks"] == 0 else None, None,
+        "lanes" if S % mesh.shape["lanes"] == 0 else None))
+
+
+def rows_sharding(mesh: Mesh, B: int, ndim: int) -> NamedSharding:
+    """Row-parallel sharding for per-row-independent kernels (the
+    HighwayHash batch): B spreads over EVERY mesh axis when divisible,
+    remaining dims replicated."""
+    if B % mesh.size == 0:
+        return NamedSharding(
+            mesh, P(tuple(mesh.axis_names), *([None] * (ndim - 1))))
+    return NamedSharding(mesh, P())
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
