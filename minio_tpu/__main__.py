@@ -277,6 +277,16 @@ def _serve(args) -> int:
             if qdir:
                 target = QueueStoreTarget(target, qdir)
             server.notifier.register_target(target)
+    # Broker sinks (nats/nsq/mqtt/redis/es/kafka/amqp/postgres/mysql;
+    # ref pkg/event/target suite) share the same env conventions.
+    from .event.brokers import targets_from_env
+    from .event.targets import QueueStoreTarget as _QS
+    for target in targets_from_env():
+        qdir = os.environ.get(
+            f"MINIO_NOTIFY_{target.env_name}_QUEUE_DIR", "")
+        if qdir:
+            target = _QS(target, qdir)
+        server.notifier.register_target(target)
 
     # Background data crawler: usage + lifecycle + heal sampling
     # (ref initDataCrawler, cmd/server-main.go:497).

@@ -1,0 +1,497 @@
+"""Broker event sinks vs in-process fake brokers that decode the REAL
+wire bytes (ref pkg/event/target/*_test.go patterns — the reference
+tests against live containers; here the protocol servers are embedded)."""
+
+import json
+import socket
+import struct
+import threading
+
+import pytest
+
+from minio_tpu.event import brokers
+
+EVENT = {"EventName": "s3:ObjectCreated:Put", "Key": "b/k",
+         "Records": [{"s3": {"bucket": {"name": "b"},
+                             "object": {"key": "k"}}}]}
+
+
+class FakeBroker:
+    """One-connection-at-a-time TCP fake; handler decodes the protocol
+    and appends delivered payload bytes to self.got."""
+
+    def __init__(self, handler):
+        self.got: list[bytes] = []
+        self.handler = handler
+        self.srv = socket.socket()
+        self.srv.bind(("127.0.0.1", 0))
+        self.srv.listen(8)
+        self.port = self.srv.getsockname()[1]
+        self._stop = False
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        while not self._stop:
+            try:
+                conn, _ = self.srv.accept()
+            except OSError:
+                return
+            try:
+                conn.settimeout(5)
+                self.handler(conn, self.got)
+            except Exception:
+                pass
+            finally:
+                conn.close()
+
+    def stop(self):
+        self._stop = True
+        self.srv.close()
+
+
+def _recv_exact(s, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = s.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("eof")
+        buf += chunk
+    return buf
+
+
+def _assert_delivered(got: list[bytes]):
+    assert got, "no payload delivered"
+    assert json.loads(got[-1].decode()) == EVENT
+
+
+# --- NATS --------------------------------------------------------------------
+
+
+def _nats_handler(conn, got):
+    conn.sendall(b'INFO {"server_id":"fake"}\r\n')
+    f = conn.makefile("rb")
+    line = f.readline()                    # CONNECT
+    assert line.startswith(b"CONNECT")
+    conn.sendall(b"+OK\r\n")
+    pub = f.readline().split()             # PUB subj len
+    assert pub[0] == b"PUB" and pub[1] == b"minio-tpu"
+    n = int(pub[2])
+    got.append(f.read(n))
+    f.read(2)
+    conn.sendall(b"+OK\r\n")
+
+
+def test_nats_target():
+    fb = FakeBroker(_nats_handler)
+    try:
+        brokers.NATSTarget("127.0.0.1", fb.port).send(EVENT)
+        _assert_delivered(fb.got)
+    finally:
+        fb.stop()
+
+
+# --- NSQ ---------------------------------------------------------------------
+
+
+def _nsq_handler(conn, got):
+    assert _recv_exact(conn, 4) == b"  V2"
+    f = conn.makefile("rb")
+    line = f.readline()
+    assert line == b"PUB minio-tpu\n"
+    size = struct.unpack(">I", f.read(4))[0]
+    got.append(f.read(size))
+    conn.sendall(struct.pack(">I", 6) + struct.pack(">i", 0) + b"OK")
+
+
+def test_nsq_target():
+    fb = FakeBroker(_nsq_handler)
+    try:
+        brokers.NSQTarget("127.0.0.1", fb.port).send(EVENT)
+        _assert_delivered(fb.got)
+    finally:
+        fb.stop()
+
+
+# --- MQTT --------------------------------------------------------------------
+
+
+def _mqtt_remaining(conn):
+    mul, val = 1, 0
+    while True:
+        b = _recv_exact(conn, 1)[0]
+        val += (b & 0x7F) * mul
+        if not b & 0x80:
+            return val
+        mul *= 128
+
+
+def _mqtt_handler(conn, got):
+    first = _recv_exact(conn, 1)
+    assert first[0] >> 4 == 1              # CONNECT
+    n = _mqtt_remaining(conn)
+    _recv_exact(conn, n)
+    conn.sendall(b"\x20\x02\x00\x00")      # CONNACK accepted
+    first = _recv_exact(conn, 1)
+    assert first[0] >> 4 == 3              # PUBLISH
+    n = _mqtt_remaining(conn)
+    body = _recv_exact(conn, n)
+    tlen = struct.unpack(">H", body[:2])[0]
+    assert body[2:2 + tlen] == b"minio-tpu"
+    got.append(body[2 + tlen:])
+
+
+def test_mqtt_target():
+    fb = FakeBroker(_mqtt_handler)
+    try:
+        brokers.MQTTTarget("127.0.0.1", fb.port).send(EVENT)
+        _assert_delivered(fb.got)
+    finally:
+        fb.stop()
+
+
+# --- Redis -------------------------------------------------------------------
+
+
+def _resp_read_array(f):
+    line = f.readline()
+    assert line[:1] == b"*"
+    n = int(line[1:])
+    out = []
+    for _ in range(n):
+        hdr = f.readline()
+        assert hdr[:1] == b"$"
+        size = int(hdr[1:])
+        out.append(f.read(size))
+        f.read(2)
+    return out
+
+
+def _redis_handler(conn, got):
+    f = conn.makefile("rb")
+    args = _resp_read_array(f)
+    if args[0] == b"RPUSH":
+        assert args[1] == b"minio-tpu"
+        got.append(args[2])
+        conn.sendall(b":1\r\n")
+    elif args[0] == b"HSET":
+        assert args[1] == b"minio-tpu" and args[2] == b"b/k"
+        got.append(args[3])
+        conn.sendall(b":1\r\n")
+
+
+def test_redis_target_access_format():
+    fb = FakeBroker(_redis_handler)
+    try:
+        brokers.RedisTarget("127.0.0.1", fb.port).send(EVENT)
+        _assert_delivered(fb.got)
+    finally:
+        fb.stop()
+
+
+def test_redis_target_namespace_format():
+    fb = FakeBroker(_redis_handler)
+    try:
+        brokers.RedisTarget("127.0.0.1", fb.port,
+                            fmt="namespace").send(EVENT)
+        _assert_delivered(fb.got)
+    finally:
+        fb.stop()
+
+
+# --- Elasticsearch -----------------------------------------------------------
+
+
+def _es_handler(conn, got):
+    f = conn.makefile("rb")
+    req = f.readline()
+    assert req.startswith(b"POST /minio-tpu/_doc")
+    length = 0
+    while True:
+        line = f.readline()
+        if line.lower().startswith(b"content-length:"):
+            length = int(line.split(b":")[1])
+        if line in (b"\r\n", b"\n", b""):
+            break
+    got.append(f.read(length))
+    conn.sendall(b"HTTP/1.1 201 Created\r\nContent-Length: 2\r\n\r\n{}")
+
+
+def test_elasticsearch_target():
+    fb = FakeBroker(_es_handler)
+    try:
+        brokers.ElasticsearchTarget(
+            f"http://127.0.0.1:{fb.port}").send(EVENT)
+        _assert_delivered(fb.got)
+    finally:
+        fb.stop()
+
+
+# --- Kafka -------------------------------------------------------------------
+
+
+def _kafka_handler(conn, got):
+    size = struct.unpack(">i", _recv_exact(conn, 4))[0]
+    req = _recv_exact(conn, size)
+    api, ver, corr = struct.unpack_from(">hhi", req, 0)
+    assert (api, ver) == (0, 0)
+    off = 8
+    clen = struct.unpack_from(">h", req, off)[0]
+    off += 2 + clen
+    _acks, _timeout = struct.unpack_from(">hi", req, off)
+    off += 6
+    ntopics = struct.unpack_from(">i", req, off)[0]
+    off += 4
+    assert ntopics == 1
+    tlen = struct.unpack_from(">h", req, off)[0]
+    topic = req[off + 2:off + 2 + tlen]
+    assert topic == b"minio-tpu"
+    off += 2 + tlen
+    _nparts = struct.unpack_from(">i", req, off)[0]
+    off += 4
+    _pid, msize = struct.unpack_from(">ii", req, off)
+    off += 8
+    mset = req[off:off + msize]
+    # offset(8) size(4) crc(4) magic(1) attrs(1) keylen(4) key vlen(4) v
+    _off0, _sz = struct.unpack_from(">qi", mset, 0)
+    crc = struct.unpack_from(">I", mset, 12)[0]
+    body = mset[16:]
+    import zlib
+    assert zlib.crc32(body) == crc
+    klen = struct.unpack_from(">i", body, 2)[0]
+    vstart = 6 + klen
+    vlen = struct.unpack_from(">i", body, vstart)[0]
+    got.append(body[vstart + 4:vstart + 4 + vlen])
+    # Response: corr + topics
+    resp = (struct.pack(">i", corr) + struct.pack(">i", 1)
+            + struct.pack(">h", len(topic)) + topic
+            + struct.pack(">i", 1) + struct.pack(">ihq", 0, 0, 0))
+    conn.sendall(struct.pack(">i", len(resp)) + resp)
+
+
+def test_kafka_target():
+    fb = FakeBroker(_kafka_handler)
+    try:
+        brokers.KafkaTarget("127.0.0.1", fb.port).send(EVENT)
+        _assert_delivered(fb.got)
+    finally:
+        fb.stop()
+
+
+def test_kafka_broker_error_raises():
+    def bad_handler(conn, got):
+        size = struct.unpack(">i", _recv_exact(conn, 4))[0]
+        _recv_exact(conn, size)
+        resp = (struct.pack(">i", 1) + struct.pack(">i", 1)
+                + struct.pack(">h", 9) + b"minio-tpu"
+                + struct.pack(">i", 1)
+                + struct.pack(">ihq", 0, 6, 0))   # error 6
+        conn.sendall(struct.pack(">i", len(resp)) + resp)
+
+    fb = FakeBroker(bad_handler)
+    try:
+        with pytest.raises(ConnectionError):
+            brokers.KafkaTarget("127.0.0.1", fb.port).send(EVENT)
+    finally:
+        fb.stop()
+
+
+# --- AMQP --------------------------------------------------------------------
+
+
+def _amqp_send_method(conn, channel, cls, mid, args=b""):
+    payload = struct.pack(">HH", cls, mid) + args
+    conn.sendall(struct.pack(">BHI", 1, channel, len(payload))
+                 + payload + b"\xce")
+
+
+def _amqp_read_frame(conn):
+    hdr = _recv_exact(conn, 7)
+    ftype, channel, size = struct.unpack(">BHI", hdr)
+    payload = _recv_exact(conn, size)
+    assert _recv_exact(conn, 1) == b"\xce"
+    return ftype, channel, payload
+
+
+def _amqp_handler(conn, got):
+    assert _recv_exact(conn, 8) == b"AMQP\x00\x00\x09\x01"
+    _amqp_send_method(conn, 0, 10, 10,
+                      struct.pack(">BB", 0, 9) + struct.pack(">I", 0)
+                      + struct.pack(">I", 5) + b"PLAIN"
+                      + struct.pack(">I", 5) + b"en_US")
+    _t, _c, p = _amqp_read_frame(conn)     # start-ok (carries PLAIN sasl)
+    assert struct.unpack(">HH", p[:4]) == (10, 11)
+    assert b"\x00guest\x00guest" in p
+    _amqp_send_method(conn, 0, 10, 30, struct.pack(">HIH", 8, 0, 0))
+    _t, _c, p = _amqp_read_frame(conn)     # tune-ok
+    assert struct.unpack(">HH", p[:4]) == (10, 31)
+    _t, _c, p = _amqp_read_frame(conn)     # connection.open
+    assert struct.unpack(">HH", p[:4]) == (10, 40)
+    _amqp_send_method(conn, 0, 10, 41, b"\x00")
+    _t, _c, p = _amqp_read_frame(conn)     # channel.open
+    assert struct.unpack(">HH", p[:4]) == (20, 10)
+    _amqp_send_method(conn, 1, 20, 11, struct.pack(">I", 0))
+    _t, _c, p = _amqp_read_frame(conn)     # basic.publish
+    assert struct.unpack(">HH", p[:4]) == (60, 40)
+    body = p[4 + 2:]
+    elen = body[0]
+    assert body[1:1 + elen] == b""         # default exchange
+    rest = body[1 + elen:]
+    rlen = rest[0]
+    assert rest[1:1 + rlen] == b"minio-tpu"
+    ftype, _c, p = _amqp_read_frame(conn)  # content header
+    assert ftype == 2
+    _cls, _w, size, _flags = struct.unpack(">HHQH", p)
+    ftype, _c, p = _amqp_read_frame(conn)  # body
+    assert ftype == 3 and len(p) == size
+    got.append(p)
+    _t, _c, p = _amqp_read_frame(conn)     # connection.close
+    assert struct.unpack(">HH", p[:4]) == (10, 50)
+    _amqp_send_method(conn, 0, 10, 51)     # close-ok
+
+
+def test_amqp_target():
+    fb = FakeBroker(_amqp_handler)
+    try:
+        brokers.AMQPTarget("127.0.0.1", fb.port).send(EVENT)
+        _assert_delivered(fb.got)
+    finally:
+        fb.stop()
+
+
+# --- PostgreSQL --------------------------------------------------------------
+
+
+def _pg_handler(conn, got):
+    size = struct.unpack(">I", _recv_exact(conn, 4))[0]
+    startup = _recv_exact(conn, size - 4)
+    assert struct.unpack(">I", startup[:4])[0] == 196608
+    assert b"user\x00postgres" in startup
+    conn.sendall(b"R" + struct.pack(">II", 8, 0))        # AuthOk
+    conn.sendall(b"Z" + struct.pack(">I", 5) + b"I")     # ReadyForQuery
+    tag = _recv_exact(conn, 1)
+    assert tag == b"Q"
+    size = struct.unpack(">I", _recv_exact(conn, 4))[0]
+    sql = _recv_exact(conn, size - 4)[:-1].decode()
+    assert sql.startswith("INSERT INTO minio_tpu")
+    start = sql.index("'")
+    parts = sql[start:].split("', '")
+    got.append(parts[1][:-2].replace("''", "'").encode())
+    done = b"INSERT 0 1\x00"
+    conn.sendall(b"C" + struct.pack(">I", len(done) + 4) + done)
+    conn.sendall(b"Z" + struct.pack(">I", 5) + b"I")
+
+
+def test_postgres_target():
+    fb = FakeBroker(_pg_handler)
+    try:
+        brokers.PostgresTarget("127.0.0.1", fb.port).send(EVENT)
+        _assert_delivered(fb.got)
+    finally:
+        fb.stop()
+
+
+# --- MySQL -------------------------------------------------------------------
+
+
+def _mysql_packet(seq, body):
+    n = len(body)
+    return bytes((n & 0xFF, (n >> 8) & 0xFF, (n >> 16) & 0xFF,
+                  seq)) + body
+
+
+def _mysql_handler(conn, got):
+    salt1, salt2 = b"12345678", b"901234567890"
+    greet = (bytes([10]) + b"5.7.0-fake\x00"
+             + struct.pack("<I", 1) + salt1 + b"\x00"
+             + struct.pack("<H", 0xF7FF) + bytes([33])
+             + struct.pack("<H", 2) + struct.pack("<H", 0x8001)
+             + bytes([21]) + b"\x00" * 10 + salt2 + b"\x00"
+             + b"mysql_native_password\x00")
+    conn.sendall(_mysql_packet(0, greet))
+    hdr = _recv_exact(conn, 4)
+    size = hdr[0] | (hdr[1] << 8) | (hdr[2] << 16)
+    login = _recv_exact(conn, size)
+    assert b"root\x00" in login
+    conn.sendall(_mysql_packet(2, b"\x00\x00\x00\x02\x00\x00\x00"))  # OK
+    hdr = _recv_exact(conn, 4)
+    size = hdr[0] | (hdr[1] << 8) | (hdr[2] << 16)
+    q = _recv_exact(conn, size)
+    assert q[0] == 3
+    sql = q[1:].decode()
+    assert sql.startswith("INSERT INTO minio_tpu")
+    start = sql.index("'")
+    parts = sql[start:].split("', '")
+    got.append(parts[1][:-2].replace("''", "'").encode())
+    conn.sendall(_mysql_packet(1, b"\x00\x01\x00\x02\x00\x00\x00"))
+
+
+def test_mysql_target():
+    fb = FakeBroker(_mysql_handler)
+    try:
+        brokers.MySQLTarget("127.0.0.1", fb.port).send(EVENT)
+        _assert_delivered(fb.got)
+    finally:
+        fb.stop()
+
+
+# --- queuestore retry integration -------------------------------------------
+
+
+def test_broker_outage_retried_via_queuestore(tmp_path):
+    """A broker target wrapped in QueueStoreTarget survives an outage:
+    events persist on disk and deliver when the broker returns (ref
+    pkg/event/target/queuestore.go contract shared by all sinks)."""
+    import time
+
+    from minio_tpu.event.targets import QueueStoreTarget
+
+    target = brokers.NATSTarget("127.0.0.1", 1)   # nothing listening
+    qt = QueueStoreTarget(target, str(tmp_path / "q"))
+    qt.RETRY_INTERVAL = 0.2
+    qt.send(EVENT)                                 # queued, not raised
+    time.sleep(0.3)
+    fb = FakeBroker(_nats_handler)
+    try:
+        target.port = fb.port                      # broker comes up
+        deadline = time.time() + 10
+        while time.time() < deadline and not fb.got:
+            time.sleep(0.1)
+        _assert_delivered(fb.got)
+    finally:
+        qt.close()
+        fb.stop()
+
+
+def test_amqp_broker_rejection_raises():
+    """A broker channel.close instead of close-ok surfaces as an error
+    (queuestore retry contract)."""
+    def reject_handler(conn, got):
+        assert _recv_exact(conn, 8) == b"AMQP\x00\x00\x09\x01"
+        _amqp_send_method(conn, 0, 10, 10,
+                          struct.pack(">BB", 0, 9) + struct.pack(">I", 0)
+                          + struct.pack(">I", 5) + b"PLAIN"
+                          + struct.pack(">I", 5) + b"en_US")
+        _amqp_read_frame(conn)             # start-ok
+        _amqp_send_method(conn, 0, 10, 30, struct.pack(">HIH", 8, 0, 0))
+        _amqp_read_frame(conn)             # tune-ok
+        _amqp_read_frame(conn)             # connection.open
+        _amqp_send_method(conn, 0, 10, 41, b"\x00")
+        _amqp_read_frame(conn)             # channel.open
+        _amqp_send_method(conn, 1, 20, 11, struct.pack(">I", 0))
+        _amqp_read_frame(conn)             # basic.publish
+        _amqp_read_frame(conn)             # content header
+        _amqp_read_frame(conn)             # body
+        _amqp_read_frame(conn)             # connection.close from client
+        # Reject: channel.close 404 instead of close-ok.
+        _amqp_send_method(conn, 1, 20, 40,
+                          struct.pack(">H", 404)
+                          + struct.pack(">B", 9) + b"NOT_FOUND"
+                          + struct.pack(">HH", 60, 40))
+
+    fb = FakeBroker(reject_handler)
+    try:
+        with pytest.raises(ConnectionError, match="404"):
+            brokers.AMQPTarget("127.0.0.1", fb.port).send(EVENT)
+    finally:
+        fb.stop()
