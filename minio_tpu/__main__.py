@@ -119,7 +119,7 @@ def _serve_gateway(args) -> int:
     layer = _maybe_wrap_cache(layer)
     server = S3Server(layer, access, secret,
                       iam=_make_iam(layer, access, secret))
-    port = server.start(host, port)
+    port = server.start(host, port, cert_manager=_certs())
     _announce(f"minio-tpu gateway [{args.backend}] -> {args.target}, "
               f"listening on {host}:{port}", access)
     _wait_for_sigterm()
@@ -186,6 +186,13 @@ def build_object_layer(disk_args: list[str],
     return layer
 
 
+def _certs():
+    """HTTPS when a cert pair exists (env or ~/.minio-tpu/certs; ref
+    cmd/config-dir.go certsDir auto-detection)."""
+    from .utils.certs import CertManager
+    return CertManager.from_env()
+
+
 def _make_iam(layer, access: str, secret: str):
     """IAM persisted on the store's own first erasure set — or on the
     single FS root (ref iam-object-store in .minio.sys)."""
@@ -213,6 +220,14 @@ def _serve(args) -> int:
 
     distributed = any(a.startswith(("http://", "https://"))
                       for a in args.disks)
+    if any(a.startswith("https://") for a in args.disks) \
+            and _certs() is None:
+        print("error: https:// cluster endpoints require server "
+              "certificates (MINIO_CERT_FILE/MINIO_KEY_FILE or "
+              "~/.minio-tpu/certs/public.crt+private.key) — without "
+              "them peers cannot complete TLS handshakes against this "
+              "node", file=sys.stderr)
+        return 1
     try:
         if distributed:
             # Start HTTP first (peers need our storage RPC during
@@ -224,7 +239,7 @@ def _serve(args) -> int:
                 derive_cluster_key(access, secret))
             server = S3Server(None, access, secret,
                               rpc_registry=boot_registry)
-            port = server.start(host, port)
+            port = server.start(host, port, cert_manager=_certs())
             my_host = "127.0.0.1" if host in ("0.0.0.0", "") else host
             node = build_cluster_node(args.disks, my_host, port,
                                       access, secret, args.block_size,
@@ -249,7 +264,7 @@ def _serve(args) -> int:
                 build_object_layer(args.disks, args.block_size))
             server = S3Server(layer, access, secret,
                               iam=_make_iam(layer, access, secret))
-            port = server.start(host, port)
+            port = server.start(host, port, cert_manager=_certs())
     except (ValueError, TimeoutError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 1

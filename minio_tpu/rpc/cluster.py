@@ -52,6 +52,7 @@ class Endpoint:
     host: str | None   # None => plain local path
     port: int | None
     path: str
+    secure: bool = False   # https:// endpoint (TLS internode)
 
     @property
     def is_url(self) -> bool:
@@ -73,7 +74,8 @@ def parse_endpoint(arg: str) -> Endpoint:
             raise ValueError(f"endpoint needs an explicit port: {arg}")
         if not u.path or u.path == "/":
             raise ValueError(f"endpoint needs a disk path: {arg}")
-        return Endpoint(u.hostname, u.port, u.path)
+        return Endpoint(u.hostname, u.port, u.path,
+                        secure=u.scheme == "https")
     return Endpoint(None, None, arg)
 
 
@@ -130,6 +132,12 @@ def build_cluster_node(disk_args: list[str], my_host: str, my_port: int,
     local_disks: dict[str, XLStorage] = {}
     my_hosts = local_host_names(my_host)
 
+    any_secure = any(ep.secure for eps in pool_endpoints for ep in eps)
+    rpc_tls = None
+    if any_secure:
+        from ..utils.certs import client_context_from_env
+        rpc_tls = client_context_from_env()
+
     def realize(ep: Endpoint):
         if ep.is_local(my_hosts, my_port):
             import os
@@ -139,7 +147,8 @@ def build_cluster_node(disk_args: list[str], my_host: str, my_port: int,
             return disk
         key = ep.node_key()
         if key not in peers:
-            peers[key] = RPCClient(ep.host, ep.port, cluster_key)
+            peers[key] = RPCClient(ep.host, ep.port, cluster_key,
+                                   tls=rpc_tls if ep.secure else None)
         return RemoteStorage(peers[key], ep.path)
 
     pool_disks = [[realize(ep) for ep in eps] for eps in pool_endpoints]
@@ -172,7 +181,8 @@ def build_cluster_node(disk_args: list[str], my_host: str, my_port: int,
         if key not in my_keys:
             lock_clients.append(_RemoteLockerClient(peers.setdefault(
                 key, RPCClient(key.rsplit(":", 1)[0],
-                               int(key.rsplit(":", 1)[1]), cluster_key))))
+                               int(key.rsplit(":", 1)[1]), cluster_key,
+                               tls=rpc_tls))))
 
     # Peer control plane shares the lock/storage RPC clients (the
     # setdefault loop above guarantees one per remote node).

@@ -89,11 +89,17 @@ class RPCClient:
     OFFLINE_RETRY = 2.0
 
     def __init__(self, host: str, port: int, cluster_key: bytes,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0, tls=None):
+        """tls: ssl.SSLContext for https:// cluster endpoints (see
+        utils.certs.client_context_from_env); the HMAC signing below
+        authenticates every call either way — TLS adds transport
+        privacy (ref the reference's TLS-everywhere internode with
+        JWT auth on top)."""
         from ..utils.dyntimeout import DynamicTimeout
         self.host = host
         self.port = port
         self.cluster_key = cluster_key
+        self.tls = tls
         # Self-tuning timeout: slow peers stretch it, fast ones shrink
         # it back (ref cmd/dynamic-timeouts.go:35).
         self.dyn_timeout = DynamicTimeout(timeout, minimum=1.0)
@@ -130,8 +136,14 @@ class RPCClient:
                 if conn.sock is not None:
                     conn.sock.settimeout(t)
                 return conn, True
+        return self._new_conn(t), False
+
+    def _new_conn(self, t: float) -> http.client.HTTPConnection:
+        if self.tls is not None:
+            return http.client.HTTPSConnection(
+                self.host, self.port, timeout=t, context=self.tls)
         return http.client.HTTPConnection(self.host, self.port,
-                                          timeout=t), False
+                                          timeout=t)
 
     def _drop_pool(self) -> None:
         """Close every pooled connection (stale after a peer restart)."""

@@ -21,12 +21,16 @@ class S3ClientResponse:
 
 class S3Client:
     def __init__(self, host: str, port: int, access_key: str,
-                 secret_key: str, region: str = "us-east-1"):
+                 secret_key: str, region: str = "us-east-1",
+                 tls: "object | None" = None):
+        """tls: an ssl.SSLContext (see utils.certs.client_context) to
+        speak HTTPS; None = plaintext."""
         self.host = host
         self.port = port
         self.access_key = access_key
         self.secret_key = secret_key
         self.region = region
+        self.tls = tls
 
     def request(self, method: str, path: str, query: str = "",
                 body: bytes = b"",
@@ -38,7 +42,12 @@ class S3Client:
             hdrs = sigv4.sign_request(method, path, query, hdrs, body,
                                       self.access_key, self.secret_key,
                                       self.region)
-        conn = http.client.HTTPConnection(self.host, self.port, timeout=60)
+        if self.tls is not None:
+            conn = http.client.HTTPSConnection(
+                self.host, self.port, timeout=60, context=self.tls)
+        else:
+            conn = http.client.HTTPConnection(self.host, self.port,
+                                              timeout=60)
         try:
             url = path + (f"?{query}" if query else "")
             conn.request(method, url, body=body, headers=hdrs)

@@ -2674,8 +2674,12 @@ class S3Server:
 
     # ---------------- HTTP plumbing ----------------
 
-    def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+    def start(self, host: str = "127.0.0.1", port: int = 0,
+              cert_manager=None) -> int:
+        """cert_manager: utils.certs.CertManager for HTTPS with
+        hot-reloaded certificates (None = plaintext HTTP)."""
         server = self
+        self.cert_manager = cert_manager
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -2882,8 +2886,30 @@ class S3Server:
             daemon_threads = True
             block_on_close = False
 
+            def finish_request(self, request, client_address):
+                # TLS wraps PER CONNECTION in the handler thread — a
+                # wrapped LISTENING socket would run the blocking
+                # handshake inside the single accept loop, letting one
+                # silent client stall every new connection (trivial
+                # DoS). The handshake also gets the handler timeout.
+                if cert_manager is not None:
+                    import ssl as _ssl
+                    request.settimeout(Handler.timeout)
+                    try:
+                        request = cert_manager.context.wrap_socket(
+                            request, server_side=True)
+                    except (_ssl.SSLError, OSError, TimeoutError):
+                        try:
+                            request.close()
+                        except OSError:
+                            pass
+                        return
+                super().finish_request(request, client_address)
+
         Handler.timeout = 120  # idle keep-alive reaper
         self._httpd = _Server((host, port), Handler)
+        if cert_manager is not None:
+            cert_manager.start()
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
@@ -2898,6 +2924,8 @@ class S3Server:
         return self.handlers.kms if self.handlers else None
 
     def stop(self) -> None:
+        if getattr(self, "cert_manager", None) is not None:
+            self.cert_manager.stop()
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()

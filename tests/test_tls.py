@@ -1,0 +1,253 @@
+"""TLS: HTTPS S3 serving, cert hot-reload, and TLS internode RPC
+(ref pkg/certs hot-reload, cmd/http TLS listeners)."""
+
+import datetime
+import os
+import ssl
+import threading
+import time
+
+import pytest
+
+from minio_tpu.utils.certs import CertManager, client_context
+
+
+def _selfsigned(tmp_path, name, cn="127.0.0.1", serial=None):
+    """Write a self-signed cert/key pair; returns (cert_path, key_path,
+    serial)."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    subject = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+    serial = serial or x509.random_serial_number()
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(subject).issuer_name(subject)
+            .public_key(key.public_key()).serial_number(serial)
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=1))
+            .add_extension(x509.SubjectAlternativeName(
+                [x509.IPAddress(__import__("ipaddress").ip_address(cn))]
+                if cn[0].isdigit() else [x509.DNSName(cn)]),
+                critical=False)
+            .sign(key, hashes.SHA256()))
+    cert_path = str(tmp_path / f"{name}.crt")
+    key_path = str(tmp_path / f"{name}.key")
+    with open(cert_path, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+    with open(key_path, "wb") as f:
+        f.write(key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption()))
+    return cert_path, key_path, serial
+
+
+def _peer_serial(host, port, ca_file):
+    ctx = client_context(ca_file)
+    ctx.check_hostname = False   # CN/IP SAN is enough for the test
+    import socket
+    with socket.create_connection((host, port), timeout=5) as sock:
+        with ctx.wrap_socket(sock, server_hostname=host) as tls:
+            der = tls.getpeercert(binary_form=True)
+    from cryptography import x509
+    return x509.load_der_x509_certificate(der).serial_number
+
+
+def test_https_s3_end_to_end(tmp_path):
+    from minio_tpu.erasure.engine import ErasureObjects
+    from minio_tpu.s3.client import S3Client
+    from minio_tpu.s3.server import S3Server
+    from minio_tpu.storage.xl import XLStorage
+
+    cert, key, _ = _selfsigned(tmp_path, "srv")
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    srv = S3Server(ErasureObjects(disks, block_size=64 * 1024),
+                   "tlsadmin", "tlsadmin-secret")
+    port = srv.start(cert_manager=CertManager(cert, key))
+    try:
+        ctx = client_context(cert)
+        ctx.check_hostname = False
+        c = S3Client("127.0.0.1", port, "tlsadmin", "tlsadmin-secret",
+                     tls=ctx)
+        assert c.make_bucket("tlsb").status == 200
+        body = os.urandom(300_000)
+        assert c.put_object("tlsb", "o", body).status == 200
+        g = c.get_object("tlsb", "o")
+        assert g.status == 200 and g.body == body
+        # Plaintext client against the TLS port must fail cleanly.
+        plain = S3Client("127.0.0.1", port, "tlsadmin",
+                         "tlsadmin-secret")
+        with pytest.raises(Exception):
+            plain.make_bucket("nope")
+    finally:
+        srv.stop()
+
+
+def test_cert_hot_reload(tmp_path):
+    from minio_tpu.erasure.engine import ErasureObjects
+    from minio_tpu.s3.server import S3Server
+    from minio_tpu.storage.xl import XLStorage
+
+    cert, key, serial1 = _selfsigned(tmp_path, "live", serial=1111)
+    mgr = CertManager(cert, key, poll_s=0.1)
+    disks = [XLStorage(str(tmp_path / f"hd{i}")) for i in range(4)]
+    srv = S3Server(ErasureObjects(disks, block_size=64 * 1024),
+                   "tlsadmin", "tlsadmin-secret")
+    port = srv.start(cert_manager=mgr)
+    try:
+        assert _peer_serial("127.0.0.1", port, cert) == 1111
+        # Renew IN PLACE (same paths, new serial), like certbot does.
+        cert2, key2, _ = _selfsigned(tmp_path, "renewed", serial=2222)
+        time.sleep(0.05)
+        os.replace(key2, key)
+        os.replace(cert2, cert)
+        # touch mtimes defensively (os.replace keeps source mtime)
+        os.utime(cert)
+        os.utime(key)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if mgr.reloads and _peer_serial("127.0.0.1", port,
+                                            cert) == 2222:
+                break
+            time.sleep(0.2)
+        assert _peer_serial("127.0.0.1", port, cert) == 2222, \
+            "new handshakes still serve the old certificate"
+    finally:
+        srv.stop()
+
+
+def test_half_written_pair_keeps_old_chain_serving(tmp_path):
+    """Mid-renewal (cert swapped, key not yet): the LIVE context must
+    keep serving the old chain — a naive load_cert_chain on the live
+    context installs the new cert before discovering the key mismatch
+    and breaks every handshake until the key lands."""
+    from minio_tpu.erasure.engine import ErasureObjects
+    from minio_tpu.s3.server import S3Server
+    from minio_tpu.storage.xl import XLStorage
+
+    cert, key, _ = _selfsigned(tmp_path, "pair", serial=5)
+    mgr = CertManager(cert, key)
+    disks = [XLStorage(str(tmp_path / f"pd{i}")) for i in range(4)]
+    srv = S3Server(ErasureObjects(disks, block_size=64 * 1024),
+                   "tlsadmin", "tlsadmin-secret")
+    port = srv.start(cert_manager=mgr)
+    try:
+        ca = str(tmp_path / "pair.ca")
+        import shutil as _sh
+        _sh.copy(cert, ca)
+        assert _peer_serial("127.0.0.1", port, ca) == 5
+        cert2, _k2, _ = _selfsigned(tmp_path, "other", serial=6)
+        os.replace(cert2, cert)   # cert swapped, key NOT — mismatch
+        os.utime(cert)
+        assert mgr.check() is False       # load fails, old chain kept
+        assert mgr.reloads == 0
+        # New handshakes STILL serve the old chain.
+        assert _peer_serial("127.0.0.1", port, ca) == 5
+    finally:
+        srv.stop()
+
+
+def test_from_env_explicit_missing_raises(tmp_path, monkeypatch):
+    monkeypatch.setenv("MINIO_CERT_FILE", str(tmp_path / "nope.crt"))
+    monkeypatch.setenv("MINIO_KEY_FILE", str(tmp_path / "nope.key"))
+    with pytest.raises(FileNotFoundError):
+        CertManager.from_env()
+
+
+def test_silent_client_does_not_block_accept_loop(tmp_path):
+    """A client that connects and sends nothing must not stall other
+    connections (per-connection handshake, not in the accept loop)."""
+    import socket
+
+    from minio_tpu.erasure.engine import ErasureObjects
+    from minio_tpu.s3.client import S3Client
+    from minio_tpu.s3.server import S3Server
+    from minio_tpu.storage.xl import XLStorage
+
+    cert, key, _ = _selfsigned(tmp_path, "dos")
+    disks = [XLStorage(str(tmp_path / f"dd{i}")) for i in range(4)]
+    srv = S3Server(ErasureObjects(disks, block_size=64 * 1024),
+                   "tlsadmin", "tlsadmin-secret")
+    port = srv.start(cert_manager=CertManager(cert, key))
+    try:
+        stalled = socket.create_connection(("127.0.0.1", port))
+        try:
+            ctx = client_context(cert)
+            ctx.check_hostname = False
+            c = S3Client("127.0.0.1", port, "tlsadmin",
+                         "tlsadmin-secret", tls=ctx)
+            assert c.make_bucket("notblocked").status == 200
+        finally:
+            stalled.close()
+    finally:
+        srv.stop()
+
+
+def test_tls_internode_rpc(tmp_path, monkeypatch):
+    """2-node cluster over https:// endpoints: storage RPC, locks and
+    peer plane all ride TLS."""
+    from minio_tpu.rpc.cluster import build_cluster_node, \
+        derive_cluster_key
+    from minio_tpu.rpc.transport import RPCRegistry
+    from minio_tpu.s3.client import S3Client
+    from minio_tpu.s3.server import S3Server
+
+    ACCESS, SECRET = "clusterak", "clustersk"
+    cert, key, _ = _selfsigned(tmp_path, "node")
+    monkeypatch.setenv("MINIO_CA_FILE", cert)
+    monkeypatch.setenv("MINIO_TLS_VERIFY", "on")
+
+    servers, ports = [], []
+    for _ in range(2):
+        reg = RPCRegistry(derive_cluster_key(ACCESS, SECRET))
+        srv = S3Server(None, ACCESS, SECRET, rpc_registry=reg)
+        port = srv.start("127.0.0.1", 0,
+                         cert_manager=CertManager(cert, key))
+        servers.append((srv, reg))
+        ports.append(port)
+
+    endpoints = [f"https://127.0.0.1:{p}{tmp_path}/n{i}/d{d}"
+                 for i, p in enumerate(ports) for d in (1, 2)]
+    nodes = [None, None]
+    errors = []
+
+    def boot(i):
+        try:
+            srv, reg = servers[i]
+            node = build_cluster_node(endpoints, "127.0.0.1", ports[i],
+                                      ACCESS, SECRET,
+                                      block_size=16 * 1024,
+                                      registry=reg, format_timeout=20.0)
+            srv.set_layer(node.layer)
+            nodes[i] = node
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=boot, args=(i,)) for i in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    try:
+        assert not errors, errors
+        assert all(nodes)
+        ctx = client_context(cert)
+        ctx.check_hostname = False
+        c0 = S3Client("127.0.0.1", ports[0], ACCESS, SECRET, tls=ctx)
+        c1 = S3Client("127.0.0.1", ports[1], ACCESS, SECRET, tls=ctx)
+        assert c0.make_bucket("tlscluster").status == 200
+        body = os.urandom(120_000)
+        assert c0.put_object("tlscluster", "x", body).status == 200
+        g = c1.get_object("tlscluster", "x")   # cross-node via TLS RPC
+        assert g.status == 200 and g.body == body
+        # Peer handshake rode TLS too.
+        st = nodes[0].notification.verify_bootstrap(
+            nodes[0].peer_service.topo_hash)
+        assert st and all(v == "ok" for v in st.values())
+    finally:
+        for srv, _ in servers:
+            srv.stop()
