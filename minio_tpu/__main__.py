@@ -42,10 +42,14 @@ def main(argv: list[str] | None = None) -> int:
     gw = sub.add_parser("gateway",
                         help="serve S3 over a foreign backend "
                              "(ref cmd/gateway-main.go)")
-    gw.add_argument("backend", choices=["nas", "s3"])
+    gw.add_argument("backend",
+                    choices=["nas", "s3", "azure", "gcs", "hdfs"])
     gw.add_argument("target",
-                    help="nas: a directory; s3: http://host:port of "
-                         "the upstream store")
+                    help="nas: a directory; s3/azure/gcs/hdfs: "
+                         "http(s)://host:port of the backend "
+                         "(azure: MINIO_AZURE_ACCOUNT/_KEY; "
+                         "gcs: MINIO_GCS_PROJECT/_TOKEN; "
+                         "hdfs: MINIO_HDFS_ROOT/_USER env)")
     gw.add_argument("--address", default="0.0.0.0:9000")
     gw.add_argument("--meta-dir", default="",
                     help="s3 gateway: local dir for bucket metadata "
@@ -102,6 +106,36 @@ def _serve_gateway(args) -> int:
         from .gateway import NASGateway
         os.makedirs(args.target, exist_ok=True)
         layer = NASGateway(args.target).new_gateway_layer()
+    elif args.backend in ("azure", "gcs", "hdfs"):
+        from .bucket.replication import BucketTargetSys
+        ep = BucketTargetSys.normalize_endpoint(args.target)
+        h, _, prt = ep.partition(":")
+        https = args.target.startswith("https://")
+        meta_dir = args.meta_dir or os.path.join(
+            os.path.expanduser("~/.minio-tpu"), "gateway",
+            hashlib.sha256(ep.encode()).hexdigest()[:12])
+        os.makedirs(meta_dir, exist_ok=True)
+        if args.backend == "azure":
+            from .gateway import AzureGateway
+            layer = AzureGateway(
+                h, int(prt),
+                os.environ.get("MINIO_AZURE_ACCOUNT", ""),
+                os.environ.get("MINIO_AZURE_KEY", ""), meta_dir,
+                https=https).new_gateway_layer()
+        elif args.backend == "gcs":
+            from .gateway import GCSGateway
+            layer = GCSGateway(
+                h, int(prt),
+                os.environ.get("MINIO_GCS_PROJECT", "default"),
+                meta_dir, token=os.environ.get("MINIO_GCS_TOKEN", ""),
+                https=https).new_gateway_layer()
+        else:
+            from .gateway import HDFSGateway
+            layer = HDFSGateway(
+                h, int(prt), meta_dir,
+                root=os.environ.get("MINIO_HDFS_ROOT", "/minio-tpu"),
+                user=os.environ.get("MINIO_HDFS_USER", "minio"),
+                https=https).new_gateway_layer()
     else:
         from .bucket.replication import BucketTargetSys
         from .gateway import S3Gateway

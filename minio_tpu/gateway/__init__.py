@@ -2,5 +2,6 @@
 Gateway interface, cmd/gateway-interface.go:34 — NewGatewayLayer(creds)
 returns an ObjectLayer; backends cmd/gateway/{nas,s3,...})."""
 
+from .cloud import AzureGateway, GCSGateway, HDFSGateway  # noqa: F401
 from .nas import NASGateway  # noqa: F401
 from .s3 import S3Gateway  # noqa: F401

@@ -38,7 +38,7 @@ def _parse_iso(s: str) -> float:
     import calendar
     import time as _t
     try:
-        return calendar.timegm(_t.strptime(s.split(".")[0],
+        return calendar.timegm(_t.strptime(s.split(".")[0].rstrip("Z"),
                                            "%Y-%m-%dT%H:%M:%S"))
     except ValueError:
         return 0.0
