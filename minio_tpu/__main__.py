@@ -54,13 +54,47 @@ def main(argv: list[str] | None = None) -> int:
     gw.add_argument("--meta-dir", default="",
                     help="s3 gateway: local dir for bucket metadata "
                          "(default <target-hash> under ~/.minio-tpu)")
+    up = sub.add_parser("update",
+                        help="check for / apply a newer release "
+                             "(ref cmd/update.go)")
+    up.add_argument("--endpoint",
+                    default=os.environ.get(
+                        "MINIO_UPDATE_URL",
+                        "https://dl.min.io"),
+                    help="release endpoint serving "
+                         "/minio-tpu/release.json")
+    up.add_argument("--dry-run", action="store_true",
+                    help="only report whether an update exists")
+
     args = parser.parse_args(argv)
 
     if args.command == "server":
         return _serve(args)
     if args.command == "gateway":
         return _serve_gateway(args)
+    if args.command == "update":
+        return _update(args)
     return 2
+
+
+def _update(args) -> int:
+    from . import __version__
+    from .utils.update import UpdateError, run_update
+    try:
+        info = run_update(args.endpoint, dry_run=args.dry_run)
+    except UpdateError as e:
+        print(f"update failed: {e}", file=sys.stderr)
+        return 1
+    if not info["newer"]:
+        print(f"minio-tpu {__version__} is up to date "
+              f"(latest: {info['latest'] or 'unknown'})")
+    elif info["applied"]:
+        print(f"updated {info['current']} -> {info['latest']}; "
+              "restart the server to pick up the new code")
+    else:
+        print(f"update available: {info['current']} -> "
+              f"{info['latest']} (run without --dry-run to apply)")
+    return 0
 
 
 def _parse_address(address: str) -> tuple[str, int]:
@@ -326,6 +360,33 @@ def _serve(args) -> int:
             if qdir:
                 target = QueueStoreTarget(target, qdir)
             server.notifier.register_target(target)
+    # Federation: etcd-backed bucket DNS (ref globalDNSConfig,
+    # pkg/dns/etcd_dns.go). MINIO_PUBLIC_ADDRESS is the address other
+    # clusters should reach this one at (defaults to the bind address).
+    from .bucket.federation import BucketDNS
+    dns = BucketDNS.from_env()
+    if dns is not None and server.handlers is not None:
+        pub = os.environ.get("MINIO_PUBLIC_ADDRESS",
+                             f"{host or '127.0.0.1'}:{port}")
+        ph, sep, pp = pub.rpartition(":")
+        if not sep or not pp.isdigit():
+            print(f"error: MINIO_PUBLIC_ADDRESS must be host:port, "
+                  f"got {pub!r}", file=sys.stderr)
+            return 1
+        server.handlers.bucket_dns = dns
+        server.handlers.public_addr = (ph or "127.0.0.1", int(pp))
+        # Re-register every existing local bucket so a cluster joining
+        # (or restarting into) the federation is resolvable at once
+        # (ref initFederatorBackend, cmd/server-main.go).
+        try:
+            for b in layer.list_buckets():
+                dns.register(b["name"],
+                             *server.handlers.public_addr)
+        except Exception:
+            from .logger import Logger
+            Logger.get().log_once("bucket DNS boot registration failed",
+                                  "bucket-dns")
+
     # Broker sinks (nats/nsq/mqtt/redis/es/kafka/amqp/postgres/mysql;
     # ref pkg/event/target suite) share the same env conventions.
     from .event.brokers import targets_from_env

@@ -33,6 +33,7 @@ META_ALGORITHM = "x-internal-sse-algorithm"      # "sse-c" | "sse-s3"
 META_SEALED_KEY = "x-internal-sse-sealed-key"    # b64(nonce|ct|tag)
 META_KEY_MD5 = "x-internal-sse-c-key-md5"        # SSE-C key fingerprint
 META_KMS_KEY_ID = "x-internal-sse-kms-key-id"
+META_KMS_DATA_KEY = "x-internal-sse-kms-data-key"  # KES-wrapped DEK
 META_ACTUAL_SIZE = "x-internal-actual-size"      # plaintext length
 META_SSE_MULTIPART = "x-internal-sse-multipart"  # per-part derived keys
 

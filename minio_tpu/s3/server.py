@@ -194,6 +194,11 @@ class S3ApiHandlers:
         self.notifier = notifier
         from ..crypto.sse import LocalKMS
         self.kms = LocalKMS.from_env()
+        # External KMS (KES): SSE-S3 object keys seal under per-object
+        # data keys the KMS generates; the local master is then unused
+        # (ref cmd/crypto/kms.go KES integration).
+        from ..crypto.kms import KESClient
+        self.kes = KESClient.from_env()
         from ..bucket.replication import ReplicationPool
         self.replication = ReplicationPool(
             self.bucket_meta, self.read_for_replication, layer)
@@ -203,6 +208,10 @@ class S3ApiHandlers:
         self.storage_class = StorageClassConfig.from_env()
         self._usage_cache: dict[str, tuple[float, int]] = {}
         self._usage_mu = threading.Lock()
+        # Federation (ref globalDNSConfig): BucketDNS + this cluster's
+        # public address, set by server boot when etcd is configured.
+        self.bucket_dns = None
+        self.public_addr: tuple[str, int] | None = None
 
     # ---------------- storage class / quota ----------------
 
@@ -313,9 +322,9 @@ class S3ApiHandlers:
             fake = S3Request("GET", f"/{bucket}", "", {}, b"")
             return self._transitioned_plain(fake, info), info
         if mode:
-            okey = sse.unseal_key(self.kms.master,
-                                  info.metadata[sse.META_SEALED_KEY],
-                                  mode, bucket, key)
+            okey = sse.unseal_key(
+                self._sse_s3_master(info.metadata, bucket, key),
+                info.metadata[sse.META_SEALED_KEY], mode, bucket, key)
             data = self._sse_decrypt_read(version_id, info, okey, 0,
                                           info.size)
         else:
@@ -388,6 +397,17 @@ class S3ApiHandlers:
                 c.islower() or c.isdigit() or c in ".-"
                 for c in req.bucket):
             raise s3err.ERR_INVALID_BUCKET_NAME
+        if self.bucket_dns is not None:
+            # Federation namespace is GLOBAL: refuse names another
+            # cluster already owns (ref initFederatorBackend +
+            # MakeBucket DNS check, cmd/bucket-handlers.go).
+            try:
+                owners = self.bucket_dns.lookup(req.bucket,
+                                                cached=False)
+            except Exception:
+                owners = []
+            if any(o != self.public_addr for o in owners):
+                raise s3err.ERR_BUCKET_ALREADY_EXISTS
         try:
             self.layer.make_bucket(req.bucket)
         except BucketExists:
@@ -404,6 +424,16 @@ class S3ApiHandlers:
             self.bucket_meta.update(req.bucket,
                                     object_lock_xml=ol.ENABLED_XML,
                                     versioning="Enabled")
+        if self.bucket_dns is not None and self.public_addr:
+            # Federation: advertise this bucket cluster-wide (ref
+            # bucket DNS add on MakeBucket, cmd/bucket-handlers.go).
+            try:
+                self.bucket_dns.register(req.bucket, *self.public_addr)
+            except Exception:
+                from ..logger import Logger
+                Logger.get().log_once(
+                    f"bucket DNS register failed for {req.bucket}",
+                    "bucket-dns")
         return S3Response(200, headers={"Location": f"/{req.bucket}"})
 
     def head_bucket(self, req: S3Request) -> S3Response:
@@ -422,6 +452,11 @@ class S3ApiHandlers:
         # bucket of the same name must start clean (ref deleteBucket
         # metadata cleanup, cmd/bucket-metadata-sys.go).
         self.bucket_meta.delete(req.bucket)
+        if self.bucket_dns is not None:
+            try:
+                self.bucket_dns.unregister(req.bucket)
+            except Exception:
+                pass
         return S3Response(204)
 
     def get_location(self, req: S3Request) -> S3Response:
@@ -650,6 +685,10 @@ class S3ApiHandlers:
             return sse.SSE_C, ckey
         if (req.headers.get(sse.H_SSE) == "AES256"
                 or self._bucket_default_sse(req.bucket)):
+            if self.kes is not None:
+                # External KMS: the per-object data key is generated at
+                # seal time; no local master involved.
+                return sse.SSE_S3, b""
             if not self.kms.configured:
                 # Never encrypt under an ephemeral master — the data
                 # would be unrecoverable after restart (the reference
@@ -664,13 +703,39 @@ class S3ApiHandlers:
         from ..crypto import sse
         okey = sse.new_object_key()
         meta[sse.META_ALGORITHM] = mode
+        if mode == sse.SSE_S3 and self.kes is not None:
+            from ..crypto.kms import KMSError
+            try:
+                master, wrapped = self.kes.generate_key(req.bucket,
+                                                        req.key)
+            except KMSError:
+                raise s3err.ERR_INTERNAL_ERROR
+            meta[sse.META_KMS_DATA_KEY] = wrapped
+            meta[sse.META_KMS_KEY_ID] = self.kes.key_id
+        elif mode == sse.SSE_S3:
+            meta[sse.META_KMS_KEY_ID] = self.kms.key_id
         meta[sse.META_SEALED_KEY] = sse.seal_key(
             master, okey, mode, req.bucket, req.key)
         if mode == sse.SSE_C:
             meta[sse.META_KEY_MD5] = req.headers[sse.H_SSEC_KEY_MD5]
-        else:
-            meta[sse.META_KMS_KEY_ID] = self.kms.key_id
         return okey
+
+    def _sse_s3_master(self, metadata: dict, bucket: str,
+                       key: str) -> bytes:
+        """The key that sealed an SSE-S3 object's envelope: a KMS data
+        key (unwrapped via KES) when the object carries one, else the
+        local master."""
+        from ..crypto import sse
+        wrapped = metadata.get(sse.META_KMS_DATA_KEY, "")
+        if wrapped:
+            if self.kes is None:
+                raise s3err.ERR_INVALID_SSE_PARAMS
+            from ..crypto.kms import KMSError
+            try:
+                return self.kes.decrypt_key(wrapped, bucket, key)
+            except KMSError:
+                raise s3err.ERR_INTERNAL_ERROR
+        return self.kms.master
 
     def _sse_encrypt_body(self, req: S3Request, body: bytes,
                           meta: dict) -> bytes:
@@ -705,7 +770,7 @@ class S3ApiHandlers:
                 raise s3err.ERR_SSE_KEY_REQUIRED
             master = ckey
         else:
-            master = self.kms.master
+            master = self._sse_s3_master(metadata, bucket, key)
         try:
             return sse.unseal_key(master, metadata[sse.META_SEALED_KEY],
                                   mode, bucket, key)
@@ -2403,6 +2468,30 @@ class S3Server:
             raise s3err.ERR_ACCESS_DENIED
         return self.handlers.post_policy_upload(req, form, key)
 
+    def _federation_redirect(self, req: S3Request) -> "S3Response | None":
+        """307 to the owning cluster when the bucket lives elsewhere in
+        the federation (ref bucket DNS resolution; the reference fronts
+        this with CoreDNS — the redirect covers clients that address
+        any federated node directly)."""
+        h = self.handlers
+        if h is None or h.bucket_dns is None or not req.bucket:
+            return None
+        try:
+            records = h.bucket_dns.lookup(req.bucket)
+        except Exception:
+            return None
+        me = h.public_addr
+        others = [r for r in records if r != me]
+        if not others:
+            return None
+        host, port = others[0]
+        scheme = "https" if getattr(self, "cert_manager", None) else \
+            "http"
+        loc = f"{scheme}://{host}:{port}{req.raw_path}"
+        if req.query:
+            loc += f"?{req.query}"
+        return S3Response(307, headers={"Location": loc})
+
     def route(self, req: S3Request) -> S3Response:
         h = self.handlers
         if h is None:
@@ -2747,10 +2836,14 @@ class S3Server:
                     try:
                         resp = server.route(req)
                     except APIError as e:
-                        resp = S3Response(
-                            e.http_status,
-                            e.xml(raw_path, req.request_id),
-                            {"Content-Type": "application/xml"})
+                        resp = None
+                        if getattr(e, "code", "") == "NoSuchBucket":
+                            resp = server._federation_redirect(req)
+                        if resp is None:
+                            resp = S3Response(
+                                e.http_status,
+                                e.xml(raw_path, req.request_id),
+                                {"Content-Type": "application/xml"})
                     except (QuorumError, Exception) as e:  # noqa: BLE001
                         if isinstance(e, APIError):
                             raise

@@ -1,0 +1,166 @@
+"""Bucket federation over etcd DNS (ref pkg/dns/etcd_dns.go +
+globalDNSConfig): two clusters share a bucket namespace; requests for
+a foreign bucket redirect to its owning cluster."""
+
+import base64
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from minio_tpu.bucket.federation import BucketDNS, EtcdClient
+from minio_tpu.erasure.engine import ErasureObjects
+from minio_tpu.s3.client import S3Client
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.xl import XLStorage
+
+ACCESS, SECRET = "fedadmin", "fedadmin-secret"
+
+
+class FakeEtcd:
+    """In-memory etcd v3 JSON gateway (kv/put, kv/range,
+    kv/deleterange)."""
+
+    def __init__(self):
+        self.kv: dict[bytes, bytes] = {}
+        fake = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                doc = json.loads(self.rfile.read(n))
+                key = base64.b64decode(doc.get("key", ""))
+                out = {}
+                if self.path == "/v3/kv/put":
+                    fake.kv[key] = base64.b64decode(doc.get("value", ""))
+                elif self.path == "/v3/kv/range":
+                    end = base64.b64decode(doc.get("range_end", ""))
+                    kvs = [{"key": base64.b64encode(k).decode(),
+                            "value": base64.b64encode(v).decode()}
+                           for k, v in sorted(fake.kv.items())
+                           if k >= key and (not end or k < end)]
+                    out = {"kvs": kvs, "count": str(len(kvs))}
+                elif self.path == "/v3/kv/deleterange":
+                    end = base64.b64decode(doc.get("range_end", ""))
+                    for k in [k for k in fake.kv
+                              if k >= key and (not end or k < end)]:
+                        del fake.kv[k]
+                body = json.dumps(out).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_bucket_dns_roundtrip():
+    fe = FakeEtcd()
+    try:
+        dns = BucketDNS(EtcdClient(f"127.0.0.1:{fe.port}"),
+                        "corp.example.com")
+        dns.register("photos", "10.0.0.1", 9000)
+        dns.register("photos", "10.0.0.2", 9000)
+        dns.register("logs", "10.1.0.1", 9002)
+        assert dns.lookup("photos") == [("10.0.0.1", 9000),
+                                        ("10.0.0.2", 9000)]
+        allb = dns.list_buckets()
+        assert set(allb) == {"photos", "logs"}
+        dns.unregister("photos")
+        assert dns.lookup("photos") == []
+        assert set(dns.list_buckets()) == {"logs"}
+        # skydns layout: reversed domain in the key
+        assert any(k.startswith(b"/skydns/com/example/corp/logs/")
+                   for k in fe.kv)
+    finally:
+        fe.stop()
+
+
+@pytest.fixture
+def federation(tmp_path):
+    fe = FakeEtcd()
+    servers = []
+    ports = []
+    for i in range(2):
+        disks = [XLStorage(str(tmp_path / f"c{i}d{j}"))
+                 for j in range(4)]
+        srv = S3Server(ErasureObjects(disks, block_size=64 * 1024),
+                       ACCESS, SECRET)
+        port = srv.start()
+        dns = BucketDNS(EtcdClient(f"127.0.0.1:{fe.port}"))
+        dns.LOOKUP_TTL = 0.3   # fast cache expiry for the test
+        srv.handlers.bucket_dns = dns
+        srv.handlers.public_addr = ("127.0.0.1", port)
+        servers.append(srv)
+        ports.append(port)
+    yield servers, ports, fe
+    for s in servers:
+        s.stop()
+    fe.stop()
+
+
+def test_federated_redirect_and_follow(federation):
+    servers, ports, fe = federation
+    c0 = S3Client("127.0.0.1", ports[0], ACCESS, SECRET)
+    c1 = S3Client("127.0.0.1", ports[1], ACCESS, SECRET)
+    assert c0.make_bucket("owned-by-zero").status == 200
+    body = b"federated payload " * 1000
+    assert c0.put_object("owned-by-zero", "k", body).status == 200
+
+    # Cluster 1 doesn't have the bucket: it must answer 307 with the
+    # owner's address, not NoSuchBucket.
+    r = c1.get_object("owned-by-zero", "k")
+    assert r.status == 307, (r.status, r.body[:200])
+    loc = urllib.parse.urlsplit(r.headers["location"])
+    assert loc.port == ports[0]
+    # A client following the redirect reaches the data (re-signed).
+    c_follow = S3Client(loc.hostname, loc.port, ACCESS, SECRET)
+    g = c_follow.get_object("owned-by-zero", "k")
+    assert g.status == 200 and g.body == body
+
+    # Unknown-everywhere bucket still 404s.
+    r = c1.get_object("nowhere-bucket", "k")
+    assert r.status == 404
+
+    # Deleting the bucket clears DNS: cluster 1 then 404s (after its
+    # brief lookup cache expires).
+    assert c0.request("DELETE", "/owned-by-zero/k").status == 204
+    assert c0.delete_bucket("owned-by-zero").status == 204
+    import time
+    time.sleep(0.4)
+    r = c1.get_object("owned-by-zero", "k")
+    assert r.status == 404
+
+
+def test_make_bucket_refuses_foreign_owned_name(federation):
+    """The federation namespace is global: a name owned elsewhere is
+    BucketAlreadyExists here (ref MakeBucket DNS check)."""
+    servers, ports, fe = federation
+    c0 = S3Client("127.0.0.1", ports[0], ACCESS, SECRET)
+    c1 = S3Client("127.0.0.1", ports[1], ACCESS, SECRET)
+    assert c0.make_bucket("global-name").status == 200
+    r = c1.make_bucket("global-name")
+    assert r.status == 409, (r.status, r.body[:200])
+
+
+def test_local_bucket_never_redirects(federation):
+    servers, ports, fe = federation
+    c1 = S3Client("127.0.0.1", ports[1], ACCESS, SECRET)
+    assert c1.make_bucket("mine").status == 200
+    assert c1.put_object("mine", "x", b"data").status == 200
+    g = c1.get_object("mine", "x")
+    assert g.status == 200 and g.body == b"data"

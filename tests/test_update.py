@@ -1,0 +1,144 @@
+"""Self-update flow against a fake release server
+(ref cmd/update.go:520)."""
+
+import hashlib
+import io
+import json
+import os
+import tarfile
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from minio_tpu.utils import update as up
+
+
+def _make_release_tar(version="9.9.9"):
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        init = f'__version__ = "{version}"\n'.encode()
+        info = tarfile.TarInfo("minio_tpu/__init__.py")
+        info.size = len(init)
+        tf.addfile(info, io.BytesIO(init))
+        mod = b"VALUE = 42\n"
+        info = tarfile.TarInfo("minio_tpu/newmod.py")
+        info.size = len(mod)
+        tf.addfile(info, io.BytesIO(mod))
+    return buf.getvalue()
+
+
+class FakeRelease:
+    def __init__(self, version="9.9.9", tamper=False):
+        blob = _make_release_tar(version)
+        sha = hashlib.sha256(blob).hexdigest()
+        if tamper:
+            blob = blob + b"x"
+        fake = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path == "/minio-tpu/release.json":
+                    body = json.dumps({
+                        "version": version,
+                        "url": "/minio-tpu/release.tar.gz",
+                        "sha256": sha}).encode()
+                elif self.path == "/minio-tpu/release.tar.gz":
+                    body = blob
+                else:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def endpoint(self):
+        return f"http://127.0.0.1:{self.port}"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_check_and_apply(tmp_path):
+    fr = FakeRelease("9.9.9")
+    try:
+        info = up.check_update(fr.endpoint)
+        assert info["newer"] and info["latest"] == "9.9.9"
+        # Apply into a sandbox package dir, not the live package.
+        pkg = tmp_path / "minio_tpu"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text('__version__ = "0.1.0"\n')
+        (pkg / "oldmod.py").write_text("OLD = 1\n")
+        out = up.run_update(fr.endpoint, package_dir=str(pkg))
+        assert out["applied"]
+        assert "9.9.9" in (pkg / "__init__.py").read_text()
+        assert (pkg / "newmod.py").exists()
+        assert not (pkg / "oldmod.py").exists()
+        # Old tree preserved for rollback.
+        assert (tmp_path / "minio_tpu.bak" / "oldmod.py").exists()
+    finally:
+        fr.stop()
+
+
+def test_up_to_date_is_noop(tmp_path):
+    fr = FakeRelease("0.0.1")
+    try:
+        info = up.run_update(fr.endpoint, package_dir=str(tmp_path))
+        assert not info["newer"] and not info["applied"]
+    finally:
+        fr.stop()
+
+
+def test_checksum_mismatch_refused(tmp_path):
+    fr = FakeRelease("9.9.9", tamper=True)
+    try:
+        pkg = tmp_path / "minio_tpu"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("x = 1\n")
+        with pytest.raises(up.UpdateError, match="checksum"):
+            up.run_update(fr.endpoint, package_dir=str(pkg))
+        assert (pkg / "__init__.py").exists()  # untouched
+    finally:
+        fr.stop()
+
+
+def test_dry_run_touches_nothing(tmp_path):
+    fr = FakeRelease("9.9.9")
+    try:
+        info = up.run_update(fr.endpoint, dry_run=True,
+                             package_dir=str(tmp_path / "nope"))
+        assert info["newer"] and not info["applied"]
+    finally:
+        fr.stop()
+
+
+def test_traversal_archive_refused(tmp_path):
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        evil = b"pwned\n"
+        info = tarfile.TarInfo("../evil.py")
+        info.size = len(evil)
+        tf.addfile(info, io.BytesIO(evil))
+    path = tmp_path / "evil.tar.gz"
+    path.write_bytes(buf.getvalue())
+    pkg = tmp_path / "minio_tpu"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("x = 1\n")
+    with pytest.raises(up.UpdateError, match="unsafe|minio_tpu"):
+        up.apply_update(str(path), package_dir=str(pkg))
+    assert not (tmp_path / "evil.py").exists()
