@@ -2619,6 +2619,10 @@ class S3Server:
         if raw_path == "/minio-tpu/metrics":
             text = self.metrics.prometheus(self.layer)
             return 200, "text/plain; version=0.0.4", text.encode()
+        if raw_path in ("/minio-tpu/console", "/minio-tpu/console/") \
+                and method == "GET":
+            from .console import console_response
+            return console_response()
         if raw_path == "/minio-tpu/webrpc" and method == "POST":
             out = self.web.handle_rpc(headers, body)
             return 200, "application/json", out

@@ -219,3 +219,19 @@ def test_web_upload_enforces_quota(server, token):
     conn.close()
     assert r.status == 400
     assert b"QuotaExceeded" in body
+
+
+def test_console_served(server):
+    """The browser console SPA is served and wired to the webrpc
+    endpoints it drives (ref browser/ frontend)."""
+    srv, port = server
+    c = S3Client("127.0.0.1", port, ACCESS, SECRET)
+    r = c.request("GET", "/minio-tpu/console", sign=False)
+    assert r.status == 200
+    assert r.headers["content-type"].startswith("text/html")
+    page = r.body.decode()
+    for needle in ('/minio-tpu/webrpc', '"web." + method',
+                   'rpc("Login"', 'rpc("ListBuckets"',
+                   "/minio-tpu/web/upload/", "/minio-tpu/web/download/",
+                   'rpc("CreateURLToken"'):
+        assert needle in page, needle
