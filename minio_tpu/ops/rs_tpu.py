@@ -88,6 +88,53 @@ def _placed_any_decode(k: int, m: int, available: tuple[int, ...],
 
 
 # --- device kernel ------------------------------------------------------------
+#
+# Two implementations of the same bit-plane linear map:
+#  - rs_pallas.gf_apply: Pallas/Mosaic kernel that keeps the 16x bit-plane
+#    inflation in VMEM (bytes-only HBM traffic) — the fast path on TPU.
+#  - _gf_apply_xla below: plain XLA fallback (materializes the planes) —
+#    used on CPU, on multi-device meshes (XLA partitions it), and when
+#    Mosaic is unavailable on the platform (disabled loudly, once).
+
+_pallas_state: dict = {"enabled": None}
+
+
+def _pallas_enabled() -> bool:
+    """Pallas on a single non-CPU device, unless disabled by env or by a
+    prior compile failure. Mesh-sharded batches stay on the XLA path —
+    XLA partitions the matmul across the mesh; a pallas_call would not."""
+    import os
+    st = _pallas_state["enabled"]
+    if st is False:
+        return False
+    if os.environ.get("MINIO_TPU_NO_PALLAS"):
+        return False
+    if st is None:
+        try:
+            import jax as _jax
+            ok = any(d.platform != "cpu" for d in _jax.devices())
+            if ok:
+                # Eager one-time smoke compile: a platform without Mosaic
+                # must fall back HERE, not at a caller's jit-compile.
+                from . import rs_pallas
+                rs_pallas.smoke()
+        except Exception as exc:
+            _disable_pallas(exc)
+            return False
+        _pallas_state["enabled"] = ok
+        st = ok
+    if not st:
+        return False
+    from . import batching
+    return batching.serving_mesh() is None
+
+
+def _disable_pallas(exc: BaseException) -> None:
+    import logging
+    _pallas_state["enabled"] = False
+    logging.getLogger("minio_tpu.ops").warning(
+        "Pallas GF kernel unavailable on this platform; using the XLA "
+        "bit-plane path: %r", exc)
 
 
 def _unpack_bits(x: jnp.ndarray) -> jnp.ndarray:
@@ -108,14 +155,7 @@ def _pack_bits(bits: jnp.ndarray) -> jnp.ndarray:
 
 
 @jax.jit
-def gf_apply(big_m: jnp.ndarray, shards: jnp.ndarray) -> jnp.ndarray:
-    """Apply a bit-plane GF matrix to shard bytes.
-
-    big_m:  (8r, 8k) float/bf16 0/1 matrix (from parity_bitplane /
-            decode_bitplane).
-    shards: (..., k, S) uint8.
-    Returns (..., r, S) uint8.
-    """
+def _gf_apply_xla(big_m: jnp.ndarray, shards: jnp.ndarray) -> jnp.ndarray:
     bits = _unpack_bits(shards)
     acc = jnp.matmul(big_m.astype(jnp.bfloat16), bits,
                      preferred_element_type=jnp.float32)
@@ -123,11 +163,54 @@ def gf_apply(big_m: jnp.ndarray, shards: jnp.ndarray) -> jnp.ndarray:
     return _pack_bits(out_bits)
 
 
+def _dispatch(pallas_fn, xla_fn, big_m, x):
+    """Pallas on a single TPU, XLA otherwise. Input errors (ValueError:
+    caller bug, same on either path) propagate; anything else disables
+    the Pallas path for the process — loudly, once — and falls back.
+
+    Scope of the fallback: it protects EAGER callers, i.e. the whole
+    serving path (batching, encode_batch). When gf_apply/encode_blocks
+    are traced inside a caller's own jit (driver entry points:
+    __graft_entry__.entry, models.ec_pipeline.full_step), Mosaic
+    compiles later at the outer jit's compile and a shape-specific
+    failure surfaces THERE, by design — the driver's compile check must
+    see it, not have it silently papered over."""
+    if _pallas_enabled():
+        try:
+            return pallas_fn(big_m, x)
+        except ValueError:
+            raise
+        except Exception as exc:  # Mosaic compile/platform failure
+            _disable_pallas(exc)
+    return xla_fn(big_m, x)
+
+
+def gf_apply(big_m: jnp.ndarray, shards: jnp.ndarray) -> jnp.ndarray:
+    """Apply a bit-plane GF matrix to shard bytes.
+
+    big_m:  (8r, 8k) float/bf16 0/1 matrix (from parity_bitplane /
+            decode_bitplane).
+    shards: (..., k, S) uint8.
+    Returns (..., r, S) uint8.
+
+    Dispatches to the Pallas packed kernel on a single TPU, the XLA
+    bit-plane matmul otherwise; both are byte-identical.
+    """
+    from . import rs_pallas
+    return _dispatch(rs_pallas.gf_apply, _gf_apply_xla, big_m, shards)
+
+
 @jax.jit
+def _encode_blocks_xla(big_m: jnp.ndarray, data: jnp.ndarray) -> jnp.ndarray:
+    parity = _gf_apply_xla(big_m, data)
+    return jnp.concatenate([data, parity], axis=-2)
+
+
 def encode_blocks(big_m: jnp.ndarray, data: jnp.ndarray) -> jnp.ndarray:
     """Batched encode: (..., k, S) data shards -> (..., k+m, S) all shards."""
-    parity = gf_apply(big_m, data)
-    return jnp.concatenate([data, parity], axis=-2)
+    from . import rs_pallas
+    return _dispatch(rs_pallas.encode_blocks, _encode_blocks_xla,
+                     big_m, data)
 
 
 # --- convenience host API -----------------------------------------------------

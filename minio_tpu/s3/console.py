@@ -212,11 +212,11 @@ async function listObjects() {
       td.textContent = t;
       tr.appendChild(td);
     });
-    const act = document.createElement("td");
-    act.appendChild(dl);
-    act.appendChild(document.createTextNode(" "));
-    act.appendChild(rm);
-    tr.appendChild(act);
+    const actTd = document.createElement("td");
+    actTd.appendChild(dl);
+    actTd.appendChild(document.createTextNode(" "));
+    actTd.appendChild(rm);
+    tr.appendChild(actTd);
     tb.appendChild(tr);
   });
 }
