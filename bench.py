@@ -429,6 +429,11 @@ def main() -> None:
             tpu_gibs, cpu_gibs = bench_kernel_north_star(np, jnp, rs_tpu)
             out["value"] = round(tpu_gibs, 3)
             out["vs_baseline"] = round(tpu_gibs / cpu_gibs, 2)
+            # Which device implementation actually ran (honesty field):
+            # the Pallas packed-GF kernel, or the XLA bit-plane fallback.
+            # _pallas_enabled folds in the mesh and env-override gates.
+            out["kernel"] = ("pallas" if rs_tpu._pallas_enabled()
+                             else "xla")
         else:
             # Host-only fallback: report CPU numbers, flagged as degraded.
             import jax.numpy as jnp_cpu
