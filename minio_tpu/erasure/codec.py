@@ -117,7 +117,10 @@ class Erasure:
             return batching.get_coalescer().encode(
                 shards[None, :self.data_blocks, :],
                 self.data_blocks, self.parity_blocks)[0]
-        rs_cpu.encode(shards, self.data_blocks, self.parity_blocks)
+        from ..ops.rs_matrix import parity_matrix
+        shards[self.data_blocks:] = batching.host_apply(
+            parity_matrix(self.data_blocks, self.parity_blocks),
+            shards[:self.data_blocks])
         batching.STATS.add(False, shards[:self.data_blocks].nbytes)
         return shards
 

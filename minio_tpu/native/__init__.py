@@ -21,22 +21,33 @@ _LIB: ctypes.CDLL | None = None
 _TRIED = False
 
 
+def _compile(srcs: list[str], so: str) -> None:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    tmp = so + ".tmp"
+    subprocess.run(
+        ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+         "-o", tmp] + srcs,
+        check=True, capture_output=True, timeout=120)
+    os.replace(tmp, so)
+
+
 def _build_and_load() -> ctypes.CDLL | None:
     srcs = [os.path.join(_HERE, "highwayhash.cc"),
-            os.path.join(_HERE, "lzblock.cc")]
+            os.path.join(_HERE, "lzblock.cc"),
+            os.path.join(_HERE, "rs.cc")]
     so = os.path.join(_BUILD_DIR, "libminio_tpu_native.so")
     try:
         if (not os.path.exists(so)
                 or any(os.path.getmtime(so) < os.path.getmtime(s)
                        for s in srcs)):
-            os.makedirs(_BUILD_DIR, exist_ok=True)
-            tmp = so + ".tmp"
-            subprocess.run(
-                ["g++", "-O3", "-march=native", "-shared", "-fPIC",
-                 "-o", tmp] + srcs,
-                check=True, capture_output=True, timeout=120)
-            os.replace(tmp, so)
+            _compile(srcs, so)
         lib = ctypes.CDLL(so)
+        if not hasattr(lib, "rs_gf_apply"):
+            # Stale cached .so predating a source (mtime preserved by
+            # tar/rsync/docker-copy): rebuild rather than silently
+            # disabling EVERY native path on the missing-symbol error.
+            _compile(srcs, so)
+            lib = ctypes.CDLL(so)
         lib.hh256_hash.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
                                    ctypes.c_size_t, ctypes.c_char_p]
         lib.hh256_hash.restype = None
@@ -52,6 +63,10 @@ def _build_and_load() -> ctypes.CDLL | None:
         lib.lzb_decompress.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
                                        ctypes.c_char_p, ctypes.c_size_t]
         lib.lzb_decompress.restype = ctypes.c_long
+        lib.rs_gf_apply.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
+                                    ctypes.c_size_t, ctypes.c_void_p,
+                                    ctypes.c_size_t, ctypes.c_void_p]
+        lib.rs_gf_apply.restype = None
         return lib
     except Exception:
         return None
@@ -90,6 +105,27 @@ def hh256_chunks_native(data: bytes, chunk_size: int,
     got = lib.hh256_chunks(key, bytes(data), len(data), chunk_size, out)
     assert got == n
     return [out.raw[i * 32:(i + 1) * 32] for i in range(n)]
+
+
+def rs_apply_native(mat, data):
+    """(r, k) GF(2^8) matrix applied to (k, n) byte rows -> (r, n), via
+    the C++ nibble-shuffle kernel (native/rs.cc). None when the native
+    lib is unavailable. Byte-identical to gf256.gf_mat_vec_apply.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    import numpy as np
+    mat = np.ascontiguousarray(mat, dtype=np.uint8)
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    r, k = mat.shape
+    if data.shape[0] != k:
+        raise ValueError(f"data rows {data.shape[0]} != k={k}")
+    n = data.shape[1]
+    out = np.empty((r, n), dtype=np.uint8)
+    lib.rs_gf_apply(mat.ctypes.data, r, k, data.ctypes.data, n,
+                    out.ctypes.data)
+    return out
 
 
 def lzb_compress_native(data: bytes) -> bytes | None:

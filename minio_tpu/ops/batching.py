@@ -176,15 +176,26 @@ def _device_reconstruct(stack: np.ndarray, k: int, m: int,
     return np.asarray(rs_tpu.gf_apply(bm, device_put_batch(stack)))
 
 
+def host_apply(mat: np.ndarray, cols: np.ndarray) -> np.ndarray:
+    """(r, k) GF matrix x (k, N) bytes on the host: the C++ nibble-
+    shuffle kernel (native/rs.cc) when built, numpy table-gather
+    otherwise. Byte-identical either way (tests/test_rs_native.py)."""
+    from ..native import rs_apply_native
+    out = rs_apply_native(mat, cols)
+    if out is None:
+        out = gf_mat_vec_apply(mat, cols)
+    return out
+
+
 def _host_reconstruct(stack: np.ndarray, mat: np.ndarray) -> np.ndarray:
-    """(B, n_used, S) -> (B, n_missing, S) via one folded table-gather.
+    """(B, n_used, S) -> (B, n_missing, S) via one folded apply.
 
     RS is byte-column-independent, so the batch dim folds into the
     columns: one (n_used, B*S) apply instead of B separate ones.
     """
     B, n_used, S = stack.shape
     cols = stack.transpose(1, 0, 2).reshape(n_used, B * S)
-    out = gf_mat_vec_apply(mat, cols)
+    out = host_apply(mat, cols)
     return out.reshape(mat.shape[0], B, S).transpose(1, 0, 2)
 
 
@@ -249,13 +260,18 @@ def reconstruct_blocks(blocks: list[list[np.ndarray | None]], k: int,
 
 
 def host_encode(blocks: np.ndarray, k: int, m: int) -> np.ndarray:
-    """(B, k, S) -> (B, k+m, S) on the host, counted in STATS."""
-    from . import rs_cpu
-    out = np.zeros((blocks.shape[0], k + m, blocks.shape[2]),
-                   dtype=np.uint8)
+    """(B, k, S) -> (B, k+m, S) on the host, counted in STATS.
+
+    The batch folds into the columns of ONE matrix apply (native C++
+    when built), matching the reference's per-block encode bytes
+    exactly (ref cmd/erasure-coding.go:70)."""
+    from .rs_matrix import parity_matrix
+    B, _, S = blocks.shape
+    out = np.zeros((B, k + m, S), dtype=np.uint8)
     out[:, :k] = blocks
-    for b in range(blocks.shape[0]):
-        rs_cpu.encode(out[b], k, m)
+    cols = blocks.transpose(1, 0, 2).reshape(k, B * S)
+    parity = host_apply(parity_matrix(k, m), cols)
+    out[:, k:] = parity.reshape(m, B, S).transpose(1, 0, 2)
     STATS.add(False, blocks.nbytes)
     return out
 

@@ -11,8 +11,9 @@ klauspost Encoder contract):
 - reconstruct: rebuild all missing shards (data + parity).
 
 This is the golden model for the TPU kernels and the byte-identity oracle
-for tests. It is deliberately simple; the fast CPU path is rs_native (C++)
-and the fast device path is rs_tpu.
+for tests. It is deliberately simple; the fast CPU path is the C++
+nibble-shuffle kernel (native/rs.cc via ops/batching.host_apply) and the
+fast device path is rs_tpu/rs_pallas.
 """
 
 from __future__ import annotations
