@@ -173,6 +173,62 @@ def encode_blocks(big_m, data, *, interpret: bool = False) -> jnp.ndarray:
                       with_data=True)
 
 
+def _shard_map(fn, mesh, in_specs, out_specs):
+    """shard_map with the replication/vma checker off: the kernel body
+    is a pallas_call (whose out_shape declares no varying-axes info) and
+    contains no collectives, so the check adds nothing but rejects the
+    call. Prefers the supported jax.shard_map; falls back to the
+    experimental module (and its older check_rep keyword) on old jax."""
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    try:
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+    except TypeError:
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)
+
+
+def _apply_sharded(mesh, big_m, x, *, interpret: bool,
+                   with_data: bool) -> jnp.ndarray:
+    """Multi-chip apply: shard_map over the serving mesh, each device
+    running the packed kernel on its local (B/nb, k, S/nl) block.
+
+    GF(2^8) maps are independent per byte column and per batch row, so
+    there are ZERO collectives — the mesh only partitions work. Specs
+    come from parallel/mesh.batch_sharding (single source of truth for
+    placement), so the shard_map matches how device_put_batch laid the
+    data out and no resharding occurs.
+    """
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.mesh import batch_sharding
+    big_m, x, r, k = _norm(big_m, x)
+    if x.ndim != 3:
+        raise ValueError("sharded apply expects (B, k, S)")
+    B, _, S = x.shape
+    spec = batch_sharding(mesh, B, S).spec
+    fn = _shard_map(
+        functools.partial(_apply_jit, r=r, k=k, interpret=interpret,
+                          with_data=with_data),
+        mesh, (P(None, None), spec), spec)
+    return fn(big_m, x)
+
+
+def gf_apply_sharded(mesh, big_m, shards, *,
+                     interpret: bool = False) -> jnp.ndarray:
+    return _apply_sharded(mesh, big_m, shards, interpret=interpret,
+                          with_data=False)
+
+
+def encode_blocks_sharded(mesh, big_m, data, *,
+                          interpret: bool = False) -> jnp.ndarray:
+    """Multi-chip encode: local data+parity concat on each device."""
+    return _apply_sharded(mesh, big_m, data, interpret=interpret,
+                          with_data=True)
+
+
 def smoke() -> None:
     """Tiny eager compile+run proving Mosaic works on this platform and
     produces correct bytes; raises otherwise. Run ONCE by

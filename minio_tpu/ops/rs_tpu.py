@@ -93,16 +93,20 @@ def _placed_any_decode(k: int, m: int, available: tuple[int, ...],
 #  - rs_pallas.gf_apply: Pallas/Mosaic kernel that keeps the 16x bit-plane
 #    inflation in VMEM (bytes-only HBM traffic) — the fast path on TPU.
 #  - _gf_apply_xla below: plain XLA fallback (materializes the planes) —
-#    used on CPU, on multi-device meshes (XLA partitions it), and when
+#    used on CPU, for non-batched (2-D) inputs on a mesh, and when
 #    Mosaic is unavailable on the platform (disabled loudly, once).
+#    Mesh-sharded 3-D batches run the Pallas kernel under shard_map
+#    (rs_pallas.gf_apply_sharded) — one local kernel per chip.
 
 _pallas_state: dict = {"enabled": None}
 
 
 def _pallas_enabled() -> bool:
-    """Pallas on a single non-CPU device, unless disabled by env or by a
-    prior compile failure. Mesh-sharded batches stay on the XLA path —
-    XLA partitions the matmul across the mesh; a pallas_call would not."""
+    """Pallas on a non-CPU platform, unless disabled by env or by a
+    prior compile failure. On a single device the kernel is called
+    directly; on a multi-device serving mesh it runs under shard_map
+    (rs_pallas.gf_apply_sharded) — each chip applies the packed kernel
+    to its local block, no collectives."""
     import os
     st = _pallas_state["enabled"]
     if st is False:
@@ -123,10 +127,7 @@ def _pallas_enabled() -> bool:
             return False
         _pallas_state["enabled"] = ok
         st = ok
-    if not st:
-        return False
-    from . import batching
-    return batching.serving_mesh() is None
+    return bool(st)
 
 
 def _disable_pallas(exc: BaseException) -> None:
@@ -163,10 +164,11 @@ def _gf_apply_xla(big_m: jnp.ndarray, shards: jnp.ndarray) -> jnp.ndarray:
     return _pack_bits(out_bits)
 
 
-def _dispatch(pallas_fn, xla_fn, big_m, x):
-    """Pallas on a single TPU, XLA otherwise. Input errors (ValueError:
-    caller bug, same on either path) propagate; anything else disables
-    the Pallas path for the process — loudly, once — and falls back.
+def _dispatch(pallas_fn, pallas_sharded_fn, xla_fn, big_m, x):
+    """Pallas on TPU (direct on one device, shard_map'd over a serving
+    mesh), XLA otherwise. Input errors (ValueError: caller bug, same on
+    either path) propagate; anything else disables the Pallas path for
+    the process — loudly, once — and falls back.
 
     Scope of the fallback: it protects EAGER callers, i.e. the whole
     serving path (batching, encode_batch). When gf_apply/encode_blocks
@@ -176,8 +178,13 @@ def _dispatch(pallas_fn, xla_fn, big_m, x):
     failure surfaces THERE, by design — the driver's compile check must
     see it, not have it silently papered over."""
     if _pallas_enabled():
+        from . import batching
+        mesh = batching.serving_mesh()
         try:
-            return pallas_fn(big_m, x)
+            if mesh is None:
+                return pallas_fn(big_m, x)
+            if getattr(x, "ndim", 0) == 3:
+                return pallas_sharded_fn(mesh, big_m, x)
         except ValueError:
             raise
         except Exception as exc:  # Mosaic compile/platform failure
@@ -197,7 +204,8 @@ def gf_apply(big_m: jnp.ndarray, shards: jnp.ndarray) -> jnp.ndarray:
     bit-plane matmul otherwise; both are byte-identical.
     """
     from . import rs_pallas
-    return _dispatch(rs_pallas.gf_apply, _gf_apply_xla, big_m, shards)
+    return _dispatch(rs_pallas.gf_apply, rs_pallas.gf_apply_sharded,
+                     _gf_apply_xla, big_m, shards)
 
 
 @jax.jit
@@ -209,7 +217,8 @@ def _encode_blocks_xla(big_m: jnp.ndarray, data: jnp.ndarray) -> jnp.ndarray:
 def encode_blocks(big_m: jnp.ndarray, data: jnp.ndarray) -> jnp.ndarray:
     """Batched encode: (..., k, S) data shards -> (..., k+m, S) all shards."""
     from . import rs_pallas
-    return _dispatch(rs_pallas.encode_blocks, _encode_blocks_xla,
+    return _dispatch(rs_pallas.encode_blocks,
+                     rs_pallas.encode_blocks_sharded, _encode_blocks_xla,
                      big_m, data)
 
 
