@@ -25,7 +25,7 @@ def _compile(srcs: list[str], so: str) -> None:
     os.makedirs(_BUILD_DIR, exist_ok=True)
     tmp = so + ".tmp"
     subprocess.run(
-        ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+        ["g++", "-O3", "-march=native", "-pthread", "-shared", "-fPIC",
          "-o", tmp] + srcs,
         check=True, capture_output=True, timeout=120)
     os.replace(tmp, so)
@@ -42,7 +42,7 @@ def _build_and_load() -> ctypes.CDLL | None:
                        for s in srcs)):
             _compile(srcs, so)
         lib = ctypes.CDLL(so)
-        if not hasattr(lib, "rs_gf_apply"):
+        if not hasattr(lib, "rs_gf_apply_mt"):  # newest symbol
             # Stale cached .so predating a source (mtime preserved by
             # tar/rsync/docker-copy): rebuild rather than silently
             # disabling EVERY native path on the missing-symbol error.
@@ -67,6 +67,11 @@ def _build_and_load() -> ctypes.CDLL | None:
                                     ctypes.c_size_t, ctypes.c_void_p,
                                     ctypes.c_size_t, ctypes.c_void_p]
         lib.rs_gf_apply.restype = None
+        lib.rs_gf_apply_mt.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
+                                       ctypes.c_size_t, ctypes.c_void_p,
+                                       ctypes.c_size_t, ctypes.c_void_p,
+                                       ctypes.c_size_t]
+        lib.rs_gf_apply_mt.restype = None
         return lib
     except Exception:
         return None
@@ -107,6 +112,12 @@ def hh256_chunks_native(data: bytes, chunk_size: int,
     return [out.raw[i * 32:(i + 1) * 32] for i in range(n)]
 
 
+# Large host applies (heal sweeps, mask-group folds in degraded mode)
+# spread column ranges across threads; small ones stay single-threaded
+# so per-request latency paths and the bench baseline are unaffected.
+RS_MT_THRESHOLD = 8 * 1024 * 1024
+
+
 def rs_apply_native(mat, data):
     """(r, k) GF(2^8) matrix applied to (k, n) byte rows -> (r, n), via
     the C++ nibble-shuffle kernel (native/rs.cc). None when the native
@@ -123,8 +134,13 @@ def rs_apply_native(mat, data):
         raise ValueError(f"data rows {data.shape[0]} != k={k}")
     n = data.shape[1]
     out = np.empty((r, n), dtype=np.uint8)
-    lib.rs_gf_apply(mat.ctypes.data, r, k, data.ctypes.data, n,
-                    out.ctypes.data)
+    if data.nbytes >= RS_MT_THRESHOLD:
+        nthreads = min(8, os.cpu_count() or 1)
+        lib.rs_gf_apply_mt(mat.ctypes.data, r, k, data.ctypes.data, n,
+                           out.ctypes.data, nthreads)
+    else:
+        lib.rs_gf_apply(mat.ctypes.data, r, k, data.ctypes.data, n,
+                        out.ctypes.data)
     return out
 
 

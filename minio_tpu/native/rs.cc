@@ -19,6 +19,8 @@
 #include <cstdint>
 #include <cstring>
 #include <cstddef>
+#include <thread>
+#include <vector>
 
 #if defined(__AVX2__) || defined(__SSSE3__)
 #include <immintrin.h>
@@ -107,17 +109,51 @@ void axpy_gf(uint8_t c, const uint8_t* src, uint8_t* acc, size_t n) {
 
 }  // namespace
 
+namespace {
+
+void apply_cols(const uint8_t* mat, size_t r, size_t k,
+                const uint8_t* data, size_t n,
+                size_t col0, size_t col1, uint8_t* out) {
+    for (size_t i = 0; i < r; i++) {
+        uint8_t* acc = out + i * n + col0;
+        std::memset(acc, 0, col1 - col0);
+        for (size_t j = 0; j < k; j++) {
+            axpy_gf(mat[i * k + j], data + j * n + col0, acc,
+                    col1 - col0);
+        }
+    }
+}
+
+}  // namespace
+
 extern "C" {
+
+// nthreads <= 1: single-threaded. Column ranges are independent (GF
+// math is per-byte-column), so threads never share output bytes.
+void rs_gf_apply_mt(const uint8_t* mat, size_t r, size_t k,
+                    const uint8_t* data, size_t n, uint8_t* out,
+                    size_t nthreads) {
+    if (nthreads <= 1 || n < 2 * nthreads) {
+        apply_cols(mat, r, k, data, n, 0, n, out);
+        return;
+    }
+    std::vector<std::thread> ts;
+    ts.reserve(nthreads);
+    // 64-byte-aligned chunk boundaries keep SIMD lanes off seams.
+    // Ceiling division: nthreads * chunk must cover ALL n columns.
+    size_t chunk = (((n + nthreads - 1) / nthreads) + 63) & ~size_t(63);
+    for (size_t t = 0; t < nthreads; t++) {
+        size_t c0 = t * chunk;
+        if (c0 >= n) break;
+        size_t c1 = c0 + chunk < n ? c0 + chunk : n;
+        ts.emplace_back(apply_cols, mat, r, k, data, n, c0, c1, out);
+    }
+    for (auto& th : ts) th.join();
+}
 
 void rs_gf_apply(const uint8_t* mat, size_t r, size_t k,
                  const uint8_t* data, size_t n, uint8_t* out) {
-    for (size_t i = 0; i < r; i++) {
-        uint8_t* acc = out + i * n;
-        std::memset(acc, 0, n);
-        for (size_t j = 0; j < k; j++) {
-            axpy_gf(mat[i * k + j], data + j * n, acc, n);
-        }
-    }
+    apply_cols(mat, r, k, data, n, 0, n, out);
 }
 
 }  // extern "C"

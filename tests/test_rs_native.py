@@ -29,6 +29,39 @@ def test_native_matches_golden(lib, k, m):
         assert np.array_equal(got, gf_mat_vec_apply(pm, data)), n
 
 
+def test_native_mt_matches_single(lib):
+    """Threaded column-split kernel is byte-identical to the
+    single-threaded one regardless of chunk seams (forced to 4 threads —
+    cpu_count may be 1 in CI, which would skip the threaded branch)."""
+    import ctypes
+    rng = np.random.default_rng(7)
+    k, m = 8, 4
+    n = 1_000_037  # odd size: ragged last chunk crosses SIMD width
+    data = np.ascontiguousarray(
+        rng.integers(0, 256, (k, n)).astype(np.uint8))
+    pm = np.ascontiguousarray(parity_matrix(k, m))
+    out = np.empty((m, n), dtype=np.uint8)
+    lib.rs_gf_apply_mt(pm.ctypes.data, m, k, data.ctypes.data, n,
+                       out.ctypes.data, 4)
+    assert np.array_equal(out, gf_mat_vec_apply(pm, data))
+    # Regression: n where floor(n/nthreads) is already a 64-multiple and
+    # n % nthreads != 0 — a floor-based chunk split left the last
+    # columns unwritten (returned np.empty garbage).
+    n = 8 * 131072 + 3
+    data = np.ascontiguousarray(
+        rng.integers(0, 256, (k, n)).astype(np.uint8))
+    out = np.empty((m, n), dtype=np.uint8)
+    lib.rs_gf_apply_mt(pm.ctypes.data, m, k, data.ctypes.data, n,
+                       out.ctypes.data, 8)
+    assert np.array_equal(out, gf_mat_vec_apply(pm, data))
+    # wrapper path over the threshold (whatever cpu_count dictates)
+    big = np.ascontiguousarray(
+        rng.integers(0, 256, (k, native.RS_MT_THRESHOLD // k + 1)
+                     ).astype(np.uint8))
+    got = native.rs_apply_native(pm, big)
+    assert np.array_equal(got, gf_mat_vec_apply(pm, big))
+
+
 def test_native_decode_matrix(lib):
     k, m = 8, 4
     rng = np.random.default_rng(1)
