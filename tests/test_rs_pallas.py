@@ -46,6 +46,19 @@ def test_encode_small_and_2d():
         assert np.array_equal(got, want), S
 
 
+def test_encode_across_tile_seam():
+    """S spanning a full lane tile plus a padded remainder (grid > 1
+    along lanes) — guards the tile/pad boundary math."""
+    rng = np.random.default_rng(6)
+    k, m = 4, 2
+    T = rs_pallas._tile_for(m, k, 10**9)  # the max tile actually chosen
+    S = T + 130                           # second tile mostly padding
+    data = rng.integers(0, 256, (1, k, S)).astype(np.uint8)
+    bm = rs_tpu.parity_bitplane(k, m)
+    got = np.asarray(rs_pallas.gf_apply(bm, data, interpret=True))
+    assert np.array_equal(got, _encode_ref(data, k, m))
+
+
 def test_reconstruct_byte_identity():
     """Same kernel, decode matrix: rebuild data+parity from survivors."""
     rng = np.random.default_rng(2)
