@@ -235,3 +235,27 @@ def test_console_served(server):
                    "/minio-tpu/web/upload/", "/minio-tpu/web/download/",
                    'rpc("CreateURLToken"'):
         assert needle in page, needle
+
+
+def test_console_script_no_shadowed_globals(server):
+    """Static lint of the SPA's inline script: no nested const/let/var
+    re-declaration of a top-level function or const name. A block-level
+    `const act = ...` once shadowed the global act() error wrapper used
+    earlier in the same block — a ReferenceError (temporal dead zone) on
+    every object-row render that HTML-substring tests cannot catch and
+    no JS runtime exists in CI to execute."""
+    import re
+    srv, port = server
+    c = S3Client("127.0.0.1", port, ACCESS, SECRET)
+    page = c.request("GET", "/minio-tpu/console", sign=False).body.decode()
+    scripts = re.findall(r"<script>(.*?)</script>", page, re.S)
+    assert scripts
+    src = "\n".join(scripts)
+    top_names = set(re.findall(r"^(?:async )?function (\w+)", src, re.M))
+    top_names |= set(re.findall(r"^(?:const|let) (\w+)\s*=", src, re.M))
+    shadowed = []
+    for name in top_names:
+        # any indented re-declaration of the same identifier
+        if re.search(rf"^[ \t]+(?:const|let|var)\s+{name}\b", src, re.M):
+            shadowed.append(name)
+    assert not shadowed, f"shadowed globals in console script: {shadowed}"
