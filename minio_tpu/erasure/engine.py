@@ -496,12 +496,28 @@ class ErasureObjects:
         return fis, errs
 
     def _quorum_file_info(self, bucket: str, object_name: str,
-                          version_id: str = "",
+                          version_id: str = "", *,
+                          reduce_notfound: bool = True,
                           ) -> tuple[FileInfo, list[FileInfo | None]]:
         """FileInfo agreed by >= read-quorum disks (ref
-        findFileInfoInQuorum, cmd/erasure-metadata.go)."""
+        findFileInfoInQuorum, cmd/erasure-metadata.go).
+
+        reduce_notfound: serving paths map a not-found majority to
+        ObjectNotFound (ref reduceReadQuorumErrs + errFileNotFound,
+        cmd/erasure-object.go:388-391); the HEALER passes False so a
+        below-quorum straggler copy surfaces as QuorumError and gets
+        classified dangling instead of skipped."""
         fis, errs = self._read_file_infos(bucket, object_name, version_id)
+        nf = sum(1 for e in errs if isinstance(
+            e, (serr.FileNotFound, serr.VersionNotFound)))
         if all(f is None for f in fis):
+            if nf < read_quorum(self.k):
+                # Disks failed with REAL errors (IO, unmounted) and
+                # fewer than a read quorum said not-found: a backend
+                # outage is unavailability, not a 404.
+                raise QuorumError(
+                    f"all disks failed reading {bucket}/{object_name}",
+                    list(errs))
             if any(isinstance(e, serr.VersionNotFound) for e in errs):
                 raise ObjectNotFound(f"{bucket}/{object_name}@{version_id}")
             raise ObjectNotFound(f"{bucket}/{object_name}")
@@ -513,6 +529,14 @@ class ErasureObjects:
         fi = fis[members[0]]
         rq = read_quorum(fi.erasure.data_blocks or self.k)
         if len(members) < rq:
+            # Reduce read errors before quorum-failing (ref
+            # reduceReadQuorumErrs + the errFileNotFound mapping,
+            # cmd/erasure-object.go:388-391): when enough disks agree
+            # the key is ABSENT — a lock-free stat racing a delete or a
+            # commit — that's not-found (404), not a 5xx. The healer
+            # opts out so straggler copies classify dangling.
+            if reduce_notfound and nf >= rq:
+                raise ObjectNotFound(f"{bucket}/{object_name}")
             raise QuorumError(
                 f"metadata quorum not met for {bucket}/{object_name} "
                 f"({len(members)}/{len(self.disks)}, need {rq})",
@@ -525,7 +549,13 @@ class ErasureObjects:
     def get_object_info(self, bucket: str, object_name: str,
                         version_id: str = "") -> ObjectInfo:
         self._check_bucket(bucket)
-        fi, _ = self._quorum_file_info(bucket, object_name, version_id)
+        # Same read lock as the data path: a stat racing a concurrent
+        # commit/delete must see before-or-after state, never the
+        # mid-parallel-write mixture (ref getObjectInfo taking the
+        # shared ns lock, cmd/erasure-object.go:383).
+        with self.ns_lock.read_locked(bucket, object_name):
+            fi, _ = self._quorum_file_info(bucket, object_name,
+                                           version_id)
         if fi.deleted:
             if version_id:
                 raise MethodNotAllowed(f"{bucket}/{object_name}")

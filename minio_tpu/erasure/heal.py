@@ -68,7 +68,11 @@ class Healer:
         """Returns (quorum FileInfo, per-disk state list:
         'ok'|'outdated'|'corrupt')."""
         eng = self.engine
-        fi, agreed = eng._quorum_file_info(bucket, object_name)
+        # reduce_notfound=False: a below-quorum straggler copy must
+        # surface as QuorumError so heal classifies it dangling and
+        # purges it, not as ObjectNotFound (which would skip it forever).
+        fi, agreed = eng._quorum_file_info(bucket, object_name,
+                                           reduce_notfound=False)
 
         def check(i: int) -> str:
             f = agreed[i]
@@ -98,14 +102,25 @@ class Healer:
         from ..parallel.quorum import QuorumError
         eng = self.engine
         n_disks = len(eng.disks)
+        from .engine import ObjectNotFound
         try:
             fi, states = self._classify(bucket, object_name)
-        except QuorumError:
-            # Below metadata quorum: unrecoverable (ref dangling-object
-            # classification in healObject).
+        except QuorumError as exc:
             res = HealResult(bucket, object_name, total_disks=n_disks)
-            res.dangling = True
+            # Dangling requires NOT-FOUND evidence (ref isObjectDangling:
+            # only errFileNotFound counts). A transient full-disk outage
+            # (real IO errors) must not classify an intact object
+            # unrecoverable — that path purges data once acted upon.
+            errs = exc.args[1] if len(exc.args) > 1 else []
+            real = [e for e in errs
+                    if e is not None and not isinstance(
+                        e, (serr.FileNotFound, serr.VersionNotFound))]
+            res.dangling = not real
             return res
+        except ObjectNotFound:
+            # Deleted between listing and healing: nothing to do
+            # (every disk agrees the key is absent).
+            return HealResult(bucket, object_name, total_disks=n_disks)
         res = HealResult(bucket, object_name, total_disks=n_disks)
         res.before_ok = states.count("ok")
         res.corrupt_disks = [i for i, s in enumerate(states)
