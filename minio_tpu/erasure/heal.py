@@ -99,6 +99,19 @@ class Healer:
 
     def heal_object(self, bucket: str, object_name: str,
                     dry_run: bool = False) -> HealResult:
+        """Exclusive per-object heal (ref healObject taking the object's
+        ns lock, cmd/erasure-healing.go): classify + repair must not
+        race a concurrent overwrite swapping the data dir between the
+        metadata read and the shard reads/writes. dry_run only reads
+        (classify + deep verify), so it shares the read lock and a
+        passive audit never stalls client traffic."""
+        lock = (self.engine.ns_lock.read_locked if dry_run
+                else self.engine.ns_lock.write_locked)
+        with lock(bucket, object_name):
+            return self._heal_object_locked(bucket, object_name, dry_run)
+
+    def _heal_object_locked(self, bucket: str, object_name: str,
+                            dry_run: bool = False) -> HealResult:
         from ..parallel.quorum import QuorumError
         eng = self.engine
         n_disks = len(eng.disks)
@@ -111,8 +124,7 @@ class Healer:
             # only errFileNotFound counts). A transient full-disk outage
             # (real IO errors) must not classify an intact object
             # unrecoverable — that path purges data once acted upon.
-            errs = exc.args[1] if len(exc.args) > 1 else []
-            real = [e for e in errs
+            real = [e for e in getattr(exc, "errs", [])
                     if e is not None and not isinstance(
                         e, (serr.FileNotFound, serr.VersionNotFound))]
             res.dangling = not real
@@ -313,7 +325,14 @@ class Healer:
             bucket = binfo["name"]
             self.heal_bucket(bucket)
             for obj in eng.list_objects(bucket, max_keys=1_000_000):
-                r = self.heal_object(bucket, obj.name)
+                try:
+                    r = self.heal_object(bucket, obj.name)
+                except TimeoutError:
+                    # Lock contention (e.g. a long-lived GET stream
+                    # holding the read lock): skip this object, keep
+                    # sweeping — the MRF/monitor re-sweep catches it.
+                    eng.mrf.add(bucket, obj.name)
+                    continue
                 if disk_index in r.healed_disks or not r.healed_disks:
                     results.append(r)
         return results

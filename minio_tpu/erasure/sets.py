@@ -227,6 +227,10 @@ class _SetsHealer:
                 s.healer.heal_bucket(binfo["name"])
                 for obj in s.list_objects(binfo["name"],
                                           max_keys=1_000_000):
-                    out.append(s.healer.heal_object(binfo["name"],
-                                                    obj.name))
+                    try:
+                        out.append(s.healer.heal_object(binfo["name"],
+                                                        obj.name))
+                    except TimeoutError:
+                        # Contended object: skip, keep sweeping.
+                        s.mrf.add(binfo["name"], obj.name)
         return out

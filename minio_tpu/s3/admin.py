@@ -248,8 +248,13 @@ class AdminHandlers:
             layer.healer.heal_bucket(bucket)
             for o in layer.list_objects(bucket, prefix=prefix,
                                         max_keys=1_000_000):
-                yield as_dict(layer.healer.heal_object(
-                    bucket, o.name, dry_run=dry), o.name)
+                try:
+                    yield as_dict(layer.healer.heal_object(
+                        bucket, o.name, dry_run=dry), o.name)
+                except TimeoutError:
+                    # Contended object (long-lived stream holds its
+                    # lock): report and continue the sweep.
+                    yield {"object": o.name, "skipped": "lock timeout"}
         else:
             for r in layer.healer.heal_all():
                 yield as_dict(r, f"{r.bucket}/{r.object_name}")
