@@ -48,6 +48,7 @@ class HealResult:
     corrupt_disks: list[int] = field(default_factory=list)
     missing_disks: list[int] = field(default_factory=list)
     dangling: bool = False
+    skipped_lock: bool = False  # lock-contended: requeued via MRF
 
     @property
     def healthy(self) -> bool:
@@ -98,17 +99,48 @@ class Healer:
     # -- object heal ---------------------------------------------------
 
     def heal_object(self, bucket: str, object_name: str,
-                    dry_run: bool = False) -> HealResult:
-        """Exclusive per-object heal (ref healObject taking the object's
-        ns lock, cmd/erasure-healing.go): classify + repair must not
-        race a concurrent overwrite swapping the data dir between the
-        metadata read and the shard reads/writes. dry_run only reads
-        (classify + deep verify), so it shares the read lock and a
-        passive audit never stalls client traffic."""
-        lock = (self.engine.ns_lock.read_locked if dry_run
-                else self.engine.ns_lock.write_locked)
-        with lock(bucket, object_name):
-            return self._heal_object_locked(bucket, object_name, dry_run)
+                    dry_run: bool = False,
+                    lock_timeout: float = 30.0) -> HealResult:
+        """Per-object heal under the namespace lock (ref healObject
+        taking the object's ns lock, cmd/erasure-healing.go): classify +
+        repair must not race a concurrent overwrite swapping the data
+        dir between the metadata read and the shard reads/writes.
+
+        Lock discipline: classification (metadata + deep bitrot verify)
+        is read-only, so it runs under the READ lock — sweeping a mostly
+        healthy namespace never stalls client traffic. Only when repair
+        is actually needed does the heal escalate to the write lock and
+        re-classify under it (the state may have changed in between)."""
+        with self.engine.ns_lock.read_locked(bucket, object_name,
+                                             lock_timeout):
+            res = self._heal_object_locked(bucket, object_name,
+                                           dry_run=True)
+        if (dry_run or res.dangling
+                or not (res.corrupt_disks or res.missing_disks)):
+            return res
+        with self.engine.ns_lock.write_locked(bucket, object_name,
+                                              lock_timeout):
+            return self._heal_object_locked(bucket, object_name,
+                                            dry_run=False)
+
+    def heal_object_or_queue(self, bucket: str, object_name: str,
+                             dry_run: bool = False) -> HealResult:
+        """Sweep-friendly heal: a lock-contended object (e.g. a
+        long-lived GET stream holding its read lock) is requeued via MRF
+        and reported skipped instead of aborting or stalling the sweep.
+        The single helper all sweep loops share, so skip reporting is
+        consistent everywhere."""
+        try:
+            return self.heal_object(bucket, object_name, dry_run)
+        except TimeoutError:
+            if not dry_run:
+                # Audits stay read-only: only REPAIR sweeps requeue the
+                # contended object for a real background heal.
+                self.engine.mrf.add(bucket, object_name)
+            res = HealResult(bucket, object_name,
+                             total_disks=len(self.engine.disks))
+            res.skipped_lock = True
+            return res
 
     def _heal_object_locked(self, bucket: str, object_name: str,
                             dry_run: bool = False) -> HealResult:
@@ -325,14 +357,7 @@ class Healer:
             bucket = binfo["name"]
             self.heal_bucket(bucket)
             for obj in eng.list_objects(bucket, max_keys=1_000_000):
-                try:
-                    r = self.heal_object(bucket, obj.name)
-                except TimeoutError:
-                    # Lock contention (e.g. a long-lived GET stream
-                    # holding the read lock): skip this object, keep
-                    # sweeping — the MRF/monitor re-sweep catches it.
-                    eng.mrf.add(bucket, obj.name)
-                    continue
+                r = self.heal_object_or_queue(bucket, obj.name)
                 if disk_index in r.healed_disks or not r.healed_disks:
                     results.append(r)
         return results
@@ -478,10 +503,26 @@ class MRFQueue:
             if item is not None:
                 self._heal(item)
 
+    # Contended items retry this many times with a SHORT lock wait so a
+    # handful of hot keys can't head-of-line-block the whole queue.
+    MAX_TRIES = 8
+    LOCK_WAIT_S = 3.0
+
     def _heal(self, item) -> None:
-        bucket, object_name = item
+        bucket, object_name, tries = (item if len(item) == 3
+                                      else (*item, 0))
         try:
-            self.healer.heal_object(bucket, object_name)
+            self.healer.heal_object(bucket, object_name,
+                                    lock_timeout=self.LOCK_WAIT_S)
+        except TimeoutError:
+            # Still contended: requeue to the BACK with a retry cap —
+            # the sweep loops that enqueued this expect an eventual
+            # retry, not a silent drop.
+            if tries + 1 < self.MAX_TRIES:
+                try:
+                    self.q.put_nowait((bucket, object_name, tries + 1))
+                except queue.Full:
+                    pass
         except Exception:
             pass  # background best-effort
 

@@ -273,6 +273,12 @@ class _PoolsHealer:
             lambda p: p.healer.heal_object(bucket, object_name,
                                            dry_run=dry_run))
 
+    def heal_object_or_queue(self, bucket, object_name, dry_run=False):
+        return self._pools._probe(
+            bucket, object_name,
+            lambda p: p.healer.heal_object_or_queue(
+                bucket, object_name, dry_run=dry_run))
+
     def heal_bucket(self, bucket):
         out = []
         for pool in self._pools.pools:

@@ -214,6 +214,11 @@ class _SetsHealer:
         return self._sets.set_for(object_name).healer.heal_object(
             bucket, object_name, dry_run=dry_run)
 
+    def heal_object_or_queue(self, bucket: str, object_name: str,
+                             dry_run: bool = False):
+        return self._sets.set_for(object_name).healer \
+            .heal_object_or_queue(bucket, object_name, dry_run=dry_run)
+
     def heal_bucket(self, bucket: str) -> list[int]:
         healed = []
         for s in self._sets.sets:
@@ -227,10 +232,6 @@ class _SetsHealer:
                 s.healer.heal_bucket(binfo["name"])
                 for obj in s.list_objects(binfo["name"],
                                           max_keys=1_000_000):
-                    try:
-                        out.append(s.healer.heal_object(binfo["name"],
-                                                        obj.name))
-                    except TimeoutError:
-                        # Contended object: skip, keep sweeping.
-                        s.mrf.add(binfo["name"], obj.name)
+                    out.append(s.healer.heal_object_or_queue(
+                        binfo["name"], obj.name))
         return out

@@ -377,6 +377,8 @@ class _FSHealer:
         return HealResult(bucket=bucket, object_name=object_name,
                           total_disks=1, before_ok=1, after_ok=1)
 
+    heal_object_or_queue = heal_object
+
     def heal_bucket(self, bucket):
         return None
 

@@ -393,6 +393,8 @@ class _GatewayHealer:
     def heal_object(self, bucket, object_name, dry_run=False):
         raise GatewayUnsupported("gateway: heal")
 
+    heal_object_or_queue = heal_object
+
     def heal_bucket(self, bucket):
         raise GatewayUnsupported("gateway: heal")
 

@@ -240,21 +240,21 @@ class AdminHandlers:
         synchronous handler and async sequences (ref healSequence's
         traverseAndHeal)."""
         def as_dict(r, name):
-            return {"object": name, "beforeOk": r.before_ok,
-                    "afterOk": r.after_ok,
-                    "healedDisks": r.healed_disks,
-                    "dangling": r.dangling}
+            out = {"object": name, "beforeOk": r.before_ok,
+                   "afterOk": r.after_ok,
+                   "healedDisks": r.healed_disks,
+                   "dangling": r.dangling}
+            if getattr(r, "skipped_lock", False):
+                # Contended object (long-lived stream holds its lock):
+                # requeued via MRF; reported so operators see it.
+                out["skipped"] = "lock timeout"
+            return out
         if bucket:
             layer.healer.heal_bucket(bucket)
             for o in layer.list_objects(bucket, prefix=prefix,
                                         max_keys=1_000_000):
-                try:
-                    yield as_dict(layer.healer.heal_object(
-                        bucket, o.name, dry_run=dry), o.name)
-                except TimeoutError:
-                    # Contended object (long-lived stream holds its
-                    # lock): report and continue the sweep.
-                    yield {"object": o.name, "skipped": "lock timeout"}
+                yield as_dict(layer.healer.heal_object_or_queue(
+                    bucket, o.name, dry_run=dry), o.name)
         else:
             for r in layer.healer.heal_all():
                 yield as_dict(r, f"{r.bucket}/{r.object_name}")

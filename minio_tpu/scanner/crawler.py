@@ -281,7 +281,12 @@ class DataCrawler:
         if healer is None:
             return
         try:
-            healer.heal_object(bucket, v.name)
+            # Sweep-friendly helper: a lock-contended sample requeues
+            # via MRF instead of being silently dropped until the next
+            # random 1-in-N hit.
+            heal = getattr(healer, "heal_object_or_queue",
+                           healer.heal_object)
+            heal(bucket, v.name)
             self.healed.append((bucket, v.name))
         except Exception:
             pass

@@ -333,3 +333,52 @@ def test_iam_deletion_propagates(cluster):
         time.sleep(0.05)
     assert "doomed" not in iam_b.users, \
         "revoked credential still valid on peer"
+
+
+def test_cross_node_same_key_churn(cluster):
+    """Concurrent overwrites/reads/deletes of ONE key through BOTH
+    nodes: dsync quorum locks + quorum error reduction must yield only
+    200/404 — no 5xx, no torn reads (a GET returns one writer's
+    complete body or nothing)."""
+    servers, ports, nodes, tmp = cluster
+    c0 = S3Client("127.0.0.1", ports[0], ACCESS, SECRET)
+    c1 = S3Client("127.0.0.1", ports[1], ACCESS, SECRET)
+    assert c0.make_bucket("churn").status == 200
+    bad: list = []
+    stop = threading.Event()
+
+    def churn(client, w):
+        while not stop.is_set():
+            r = client.put_object("churn", "hot", bytes([w]) * 50_000)
+            if r.status != 200:
+                bad.append(("put", r.status))
+
+    def read(client):
+        while not stop.is_set():
+            r = client.get_object("churn", "hot")
+            if r.status == 404:
+                continue
+            if r.status != 200:
+                bad.append(("get", r.status))
+            elif len(set(r.body)) != 1 or len(r.body) != 50_000:
+                bad.append(("torn", len(r.body)))
+
+    def dele(client):
+        while not stop.is_set():
+            r = client.request("DELETE", "/churn/hot")
+            if r.status not in (200, 204):
+                bad.append(("del", r.status))
+
+    ts = [threading.Thread(target=churn, args=(c0, 1)),
+          threading.Thread(target=churn, args=(c1, 2)),
+          threading.Thread(target=read, args=(c0,)),
+          threading.Thread(target=read, args=(c1,)),
+          threading.Thread(target=dele, args=(c1,))]
+    for t in ts:
+        t.start()
+    time.sleep(4)
+    stop.set()
+    for t in ts:
+        t.join(timeout=60)
+        assert not t.is_alive(), "cross-node churn thread wedged"
+    assert not bad, bad[:8]
