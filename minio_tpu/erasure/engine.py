@@ -19,6 +19,7 @@ Read path (ref getObjectWithFileInfo, cmd/erasure-object.go:240):
 from __future__ import annotations
 
 import hashlib
+import time
 import uuid
 from dataclasses import dataclass, field
 
@@ -178,8 +179,20 @@ class ErasureObjects:
     # ------------------------------------------------------------------
     # buckets
 
+    # Bucket create/delete serialize on a meta lock (ref MakeBucket /
+    # DeleteBucket taking the bucket's lock, cmd/erasure-server-pool.go):
+    # two racing, per-disk-parallel ops could otherwise BOTH "succeed"
+    # while leaving the volume on half the disks.
+    def _bucket_meta_lock(self, bucket: str):
+        return self.ns_lock.write_locked(MINIO_META_BUCKET,
+                                         f"buckets/{bucket}")
+
     def make_bucket(self, bucket: str) -> None:
         self._check_not_reserved(bucket)
+        with self._bucket_meta_lock(bucket):
+            self._make_bucket_locked(bucket)
+
+    def _make_bucket_locked(self, bucket: str) -> None:
         _, errs = parallel_map(
             [lambda d=d: d.make_volume(bucket) for d in self.disks])
         exists = [isinstance(e, serr.VolumeExists) for e in errs]
@@ -199,14 +212,39 @@ class ErasureObjects:
 
     def delete_bucket(self, bucket: str, force: bool = False) -> None:
         self._check_not_reserved(bucket)
+        with self._bucket_meta_lock(bucket):
+            self._delete_bucket_locked(bucket, force)
+
+    def _delete_bucket_locked(self, bucket: str, force: bool) -> None:
         _, errs = parallel_map(
             [lambda d=d: d.delete_volume(bucket, force=force)
              for d in self.disks])
+        def undo_removals():
+            # Restore volumes on disks where OUR delete succeeded (ref
+            # undoDeleteBucketSets, cmd/erasure-sets.go:723) — the
+            # bucket must stay fully present, not on a random subset.
+            parallel_map([lambda d=d: d.make_volume(bucket)
+                          for d, e in zip(self.disks, errs) if e is None])
+
         if any(isinstance(e, serr.VolumeExists) for e in errs):
+            # Non-empty somewhere (e.g. a racing PUT committed there).
+            undo_removals()
             raise BucketExists(f"{bucket} not empty")
         if all(isinstance(e, serr.VolumeNotFound) for e in errs):
             raise BucketNotFound(bucket)
-        reduce_quorum_errs(errs, len(self.disks) // 2 + 1, "delete_bucket")
+        # A disk where the volume is already absent counts as success:
+        # deletion is idempotent, and a concurrent delete_bucket racing
+        # this one may have removed some volumes first — the combined
+        # outcome (bucket gone) is what both callers asked for.
+        eff = [None if isinstance(e, serr.VolumeNotFound) else e
+               for e in errs]
+        try:
+            reduce_quorum_errs(eff, len(self.disks) // 2 + 1,
+                               "delete_bucket")
+        except QuorumError:
+            # Below quorum (real disk errors): undo what we removed.
+            undo_removals()
+            raise
         self.metacache.drop_bucket(bucket)
         self._mark_update(bucket)
 
@@ -260,6 +298,72 @@ class ErasureObjects:
         if bucket == MINIO_META_BUCKET or bucket.startswith(
                 MINIO_META_BUCKET + "/"):
             raise BucketNotFound(bucket)
+
+    def _raise_if_bucket_gone(self, errs, bucket: str, *,
+                              for_write: bool = False,
+                              wq: int | None = None) -> None:
+        """Map VolumeNotFound evidence to NoSuchBucket instead of a
+        quorum 5xx (ref toObjectErr mapping errVolumeNotFound ->
+        BucketNotFound, cmd/typed-errors.go).
+
+        Reads require a MAJORITY of missing volumes — agreeing with
+        bucket_exists and the make/delete-bucket quorum, so a settled
+        bucket never reads as both present and gone. Writes map a
+        write-quorum of VolumeNotFound to NoSuchBucket (the reference's
+        reduceWriteQuorumErrs bar); BELOW that bar a partial
+        VolumeNotFound is ambiguous — freshly wiped disks awaiting heal
+        (bucket exists; the quorum error is retryable) vs a racing
+        delete_bucket mid-flight (will finish or roll back within
+        moments) — so the write path lets the race settle and takes the
+        majority vote before deciding."""
+        vnf = sum(1 for e in errs if isinstance(e, serr.VolumeNotFound))
+        if vnf == 0:
+            return
+        n = len(self.disks)
+        if not for_write:
+            if vnf >= n // 2 + 1:
+                raise BucketNotFound(bucket)
+            return
+        if wq is None:
+            wq = write_quorum(self.k, self.m)
+        ok = sum(1 for e in errs if e is None)
+        if ok >= wq:
+            # The write LANDED despite stray VolumeNotFound disks (e.g.
+            # a wiped replacement awaiting heal): no settle, no stall —
+            # the per-write cost of this helper must be zero in the
+            # steady degraded state.
+            return
+        if vnf >= wq:
+            raise BucketNotFound(bucket)
+        time.sleep(0.05)
+        # Decisive only on a RESPONDING majority saying the volume is
+        # absent; zero responders is an outage (retryable 5xx), not 404.
+        _, st = parallel_map(
+            [lambda d=d: d.stat_volume(bucket) for d in self.disks])
+        absent = sum(1 for e in st if isinstance(e, serr.VolumeNotFound))
+        if absent >= n // 2 + 1:
+            raise BucketNotFound(bucket)
+
+    def guard_commit_bucket_gone(self, errs, bucket: str,
+                                 object_name: str, version_id: str, *,
+                                 wq: int | None = None) -> None:
+        """Commit-path wrapper over _raise_if_bucket_gone: when the
+        bucket vanished mid-commit, UNDO the copies that landed (disks
+        where errs[i] is None) before re-raising — 1-copy danglers
+        would otherwise block the racing delete_bucket with a phantom
+        "not empty". Shared by put_object, the delete-marker write and
+        complete_multipart_upload."""
+        try:
+            self._raise_if_bucket_gone(errs, bucket, for_write=True,
+                                       wq=wq)
+        except BucketNotFound:
+            undo_fi = FileInfo(volume=bucket, name=object_name,
+                               version_id=version_id)
+            parallel_map(
+                [lambda d=d: d.delete_version(bucket, object_name,
+                                              undo_fi)
+                 for d, e in zip(self.disks, errs) if e is None])
+            raise
 
     def _check_bucket(self, bucket: str) -> None:
         self._check_not_reserved(bucket)
@@ -358,9 +462,13 @@ class ErasureObjects:
                         alive[i] = False
                         disk_errs[i] = e
                 if sum(alive) < wq:
+                    causes = "; ".join(
+                        f"disk{i}: {type(e).__name__}: {e}"
+                        for i, e in enumerate(disk_errs)
+                        if e is not None)
                     raise QuorumError(
                         "write quorum lost mid-stream "
-                        f"({sum(alive)}/{n}, need {wq})",
+                        f"({sum(alive)}/{n}, need {wq}): {causes}",
                         [e for e in disk_errs if e is not None])
             # A hash-verifying reader raises here when the declared
             # md5/sha256/size doesn't match what streamed through —
@@ -413,6 +521,9 @@ class ErasureObjects:
             with self.ns_lock.write_locked(bucket, object_name):
                 _, errs = parallel_map(
                     [lambda i=i: commit_one(i) for i in range(n)])
+                self.guard_commit_bucket_gone(errs, bucket,
+                                              object_name, version_id,
+                                              wq=wq)
                 reduce_quorum_errs(errs, wq, "put_object")
         except BaseException:
             # Don't leak staged shards (the reference deletes the
@@ -514,7 +625,9 @@ class ErasureObjects:
             if nf < read_quorum(self.k):
                 # Disks failed with REAL errors (IO, unmounted) and
                 # fewer than a read quorum said not-found: a backend
-                # outage is unavailability, not a 404.
+                # outage is unavailability, not a 404 — unless the
+                # BUCKET itself is gone (racing delete-bucket).
+                self._raise_if_bucket_gone(errs, bucket)
                 raise QuorumError(
                     f"all disks failed reading {bucket}/{object_name}",
                     list(errs))
@@ -537,6 +650,7 @@ class ErasureObjects:
             # opts out so straggler copies classify dangling.
             if reduce_notfound and nf >= rq:
                 raise ObjectNotFound(f"{bucket}/{object_name}")
+            self._raise_if_bucket_gone(errs, bucket)
             raise QuorumError(
                 f"metadata quorum not met for {bucket}/{object_name} "
                 f"({len(members)}/{len(self.disks)}, need {rq})",
@@ -861,6 +975,9 @@ class ErasureObjects:
                     [lambda d=d: d.write_metadata(bucket, object_name,
                                                   marker)
                      for d in self.disks])
+                self.guard_commit_bucket_gone(errs, bucket,
+                                              object_name,
+                                              marker.version_id)
                 reduce_quorum_errs(errs, write_quorum(self.k, self.m),
                                    "delete_object(marker)")
             self._mark_update(bucket, object_name)
@@ -887,10 +1004,18 @@ class ErasureObjects:
             e, (serr.FileNotFound, serr.VersionNotFound)))
         if not_found == len(self.disks):
             raise ObjectNotFound(f"{bucket}/{object_name}")
+        # A missing key counts as success for a DELETE (idempotent), so
+        # fold it to None BEFORE the bucket-gone check — a degraded set
+        # (one wiped disk) deleting a nonexistent key must not pay the
+        # helper's settle path. VolumeNotFound likewise: a disk without
+        # the volume trivially holds no copy.
+        eff = [None if isinstance(e, (serr.FileNotFound,
+                                      serr.VersionNotFound)) else e
+              for e in errs]
+        self._raise_if_bucket_gone(eff, bucket, for_write=True)
         reduce_quorum_errs(
-            [None if isinstance(e, (serr.FileNotFound,
-                                    serr.VersionNotFound)) else e
-             for e in errs],
+            [None if isinstance(e, serr.VolumeNotFound) else e
+             for e in eff],
             write_quorum(self.k, self.m), "delete_object")
         self._mark_update(bucket, object_name)
         return ObjectInfo(bucket=bucket, name=object_name,
@@ -948,6 +1073,7 @@ class ErasureObjects:
             _, errs = parallel_map(
                 [lambda i=i: update_one(i)
                  for i in range(len(self.disks))])
+            self._raise_if_bucket_gone(errs, bucket, for_write=True)
             reduce_quorum_errs(errs, write_quorum(self.k, self.m),
                                "update_object_metadata")
         self._mark_update(bucket, object_name)

@@ -147,7 +147,7 @@ class Healer:
         from ..parallel.quorum import QuorumError
         eng = self.engine
         n_disks = len(eng.disks)
-        from .engine import ObjectNotFound
+        from .engine import BucketNotFound, ObjectNotFound
         try:
             fi, states = self._classify(bucket, object_name)
         except QuorumError as exc:
@@ -161,9 +161,9 @@ class Healer:
                         e, (serr.FileNotFound, serr.VersionNotFound))]
             res.dangling = not real
             return res
-        except ObjectNotFound:
-            # Deleted between listing and healing: nothing to do
-            # (every disk agrees the key is absent).
+        except (ObjectNotFound, BucketNotFound):
+            # Object — or its whole bucket — deleted between listing
+            # and healing: nothing to do; the sweep continues.
             return HealResult(bucket, object_name, total_disks=n_disks)
         res = HealResult(bucket, object_name, total_disks=n_disks)
         res.before_ok = states.count("ok")
@@ -186,7 +186,13 @@ class Healer:
 
         # A fresh replacement disk may lack the bucket volume entirely —
         # heal it first so shard/metadata writes land (ref healObject's
-        # implicit HealBucket dependency).
+        # implicit HealBucket dependency). But ONLY while a majority of
+        # disks still carry the bucket: healing must never resurrect a
+        # bucket a racing delete_bucket(force=True) just removed (the
+        # same invariant xl.py's _makedirs_for enforces on write paths).
+        if not eng.bucket_exists(bucket):
+            res.after_ok = res.before_ok
+            return res
         for i in bad:
             try:
                 eng.disks[i].stat_volume(bucket)
@@ -332,8 +338,12 @@ class Healer:
 
     def heal_bucket(self, bucket: str) -> list[int]:
         """Create the bucket volume on disks where it's missing
-        (ref HealBucket)."""
+        (ref HealBucket). Guarded by the majority vote: healing
+        stragglers must never resurrect a bucket a racing delete_bucket
+        just removed from every (or most) disks."""
         eng = self.engine
+        if not eng.bucket_exists(bucket):
+            return []
         healed = []
         for i, disk in enumerate(eng.disks):
             try:

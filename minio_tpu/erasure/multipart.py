@@ -352,6 +352,16 @@ class MultipartUploads:
             _, errs = parallel_map(
                 [lambda i=i: commit_one(i)
                  for i in range(len(eng.disks))])
+            from .engine import BucketNotFound
+            try:
+                eng.guard_commit_bucket_gone(errs, bucket, object_name,
+                                             "", wq=wq)
+            except BucketNotFound:
+                # Terminal failure: reclaim the staged parts too — the
+                # client won't abort an upload of a bucket that no
+                # longer exists.
+                self._cleanup(bucket, object_name, upload_id)
+                raise
             reduce_quorum_errs(errs, wq, "complete_multipart_upload")
         if any(e is not None for e in errs):
             eng.mrf.add(bucket, object_name)

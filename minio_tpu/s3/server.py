@@ -2848,7 +2848,24 @@ class S3Server:
                                 e.http_status,
                                 e.xml(raw_path, req.request_id),
                                 {"Content-Type": "application/xml"})
-                    except (QuorumError, Exception) as e:  # noqa: BLE001
+                    except (QuorumError, TimeoutError) as e:
+                        # Quorum races/outages and lock-acquire
+                        # timeouts are RETRYABLE: 503 SlowDown,
+                        # matching the reference's
+                        # InsufficientWriteQuorum/OperationTimedOut ->
+                        # ErrSlowDown (cmd/api-errors.go:1898). Clients
+                        # with standard retry policies recover
+                        # transparently.
+                        from ..logger import Logger
+                        Logger.get().log_once(
+                            f"{self.command} {raw_path}: quorum: {e}",
+                            "s3-handler")
+                        err = s3err.ERR_SLOW_DOWN
+                        resp = S3Response(
+                            err.http_status,
+                            err.xml(raw_path, req.request_id),
+                            {"Content-Type": "application/xml"})
+                    except Exception as e:  # noqa: BLE001
                         if isinstance(e, APIError):
                             raise
                         from ..logger import Logger

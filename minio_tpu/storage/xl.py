@@ -95,7 +95,12 @@ class XLStorage(StorageAPI):
         p = os.path.join(self.root, volume)
         if os.path.isdir(p):
             raise serr.VolumeExists(volume)
-        os.makedirs(p)
+        try:
+            os.makedirs(p)
+        except FileExistsError:
+            # TOCTOU with a concurrent make_volume: same outcome as the
+            # isdir check above.
+            raise serr.VolumeExists(volume) from None
 
     def list_volumes(self) -> list[str]:
         out = []
@@ -127,8 +132,23 @@ class XLStorage(StorageAPI):
 
     # --- flat files ---
 
-    def _atomic_write(self, full: str, data: bytes) -> None:
-        os.makedirs(os.path.dirname(full), exist_ok=True)
+    def _makedirs_for(self, volume: str, dirpath: str) -> None:
+        """makedirs with the volume re-checked IMMEDIATELY before: an
+        implicit mkdir on a write path must never resurrect a bucket
+        volume that a racing delete_bucket just removed — otherwise a
+        deleted bucket and a stored object/metadata write can both
+        report success with the volume left on a random disk subset.
+        (The microsecond residual window is absorbed by the engine's
+        majority checks and heal sweeps.)"""
+        self._check_vol(volume)
+        os.makedirs(dirpath, exist_ok=True)
+
+    def _atomic_write(self, full: str, data: bytes,
+                      volume: str | None = None) -> None:
+        if volume is not None:
+            self._makedirs_for(volume, os.path.dirname(full))
+        else:
+            os.makedirs(os.path.dirname(full), exist_ok=True)
         tmp = os.path.join(self.root, TMP_DIR, str(uuid.uuid4()))
         os.makedirs(os.path.dirname(tmp), exist_ok=True)
         try:
@@ -141,8 +161,9 @@ class XLStorage(StorageAPI):
             raise serr.FaultyDisk(str(e))
 
     def write_all(self, volume: str, path: str, data: bytes) -> None:
-        self._check_vol(volume)
-        self._atomic_write(self._file_path(volume, path), bytes(data))
+        # Volume check happens in _makedirs_for, adjacent to the mkdir.
+        self._atomic_write(self._file_path(volume, path), bytes(data),
+                           volume=volume)
 
     def read_all(self, volume: str, path: str) -> bytes:
         self._check_vol(volume)
@@ -175,13 +196,13 @@ class XLStorage(StorageAPI):
         streaming write (ref streaming CreateFile,
         cmd/xl-storage.go:1575). Streamed files land directly at the
         target path: callers always stage under tmp/ and commit via
-        rename_data, so a torn stream never becomes visible."""
-        self._check_vol(volume)
+        rename_data, so a torn stream never becomes visible.
+        (Volume check happens in _makedirs_for, adjacent to mkdir.)"""
         full = self._file_path(volume, path)
         if isinstance(data, (bytes, bytearray, memoryview)):
-            self._atomic_write(full, bytes(data))
+            self._atomic_write(full, bytes(data), volume=volume)
             return
-        os.makedirs(os.path.dirname(full), exist_ok=True)
+        self._makedirs_for(volume, os.path.dirname(full))
         try:
             with open(full, "wb") as f:
                 for chunk in data:
@@ -192,9 +213,8 @@ class XLStorage(StorageAPI):
             raise serr.FaultyDisk(str(e))
 
     def append_file(self, volume: str, path: str, data: bytes) -> None:
-        self._check_vol(volume)
         full = self._file_path(volume, path)
-        os.makedirs(os.path.dirname(full), exist_ok=True)
+        self._makedirs_for(volume, os.path.dirname(full))
         try:
             with open(full, "ab") as f:
                 f.write(data)
@@ -238,7 +258,7 @@ class XLStorage(StorageAPI):
         dst = self._file_path(dst_volume, dst_path)
         if not os.path.exists(src):
             raise serr.FileNotFound(f"{src_volume}/{src_path}")
-        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        self._makedirs_for(dst_volume, os.path.dirname(dst))
         try:
             os.replace(src, dst)
         except OSError as e:
@@ -273,16 +293,15 @@ class XLStorage(StorageAPI):
     def _write_xlmeta(self, volume: str, path: str, meta: XLMeta) -> None:
         self._atomic_write(
             self._file_path(volume, os.path.join(path, XL_META_FILE)),
-            meta.dump())
+            meta.dump(), volume=volume)
 
     def rename_data(self, src_volume: str, src_path: str, fi: FileInfo,
                     dst_volume: str, dst_path: str) -> None:
         """Commit: move <src>/<dataDir> under dst object dir, then merge
         fi as a version into dst xl.meta (ref cmd/xl-storage.go:1972)."""
         self._check_vol(src_volume)
-        self._check_vol(dst_volume)
         dst_obj_dir = self._file_path(dst_volume, dst_path)
-        os.makedirs(dst_obj_dir, exist_ok=True)
+        self._makedirs_for(dst_volume, dst_obj_dir)
         if fi.data_dir:
             src_dd = self._file_path(src_volume,
                                      os.path.join(src_path, fi.data_dir))

@@ -137,17 +137,25 @@ def test_node_loss_degraded_read(cluster):
     servers[1][0].stop()
     for client in nodes[0].peers.values():
         client.close()
-    # Keep the write-lock timeout short so the blocked-PUT probe is fast.
+    # Keep the write-lock timeout short so the blocked-PUT probe is fast
+    # (restored in the finally: the module-scoped cluster is shared and a
+    # leaked 1s timeout makes later contention tests flaky).
+    old_timeouts = [s.ns_lock.default_timeout
+                    for s in nodes[0].layer.pools[0].sets]
     for s in nodes[0].layer.pools[0].sets:
         s.ns_lock.default_timeout = 1.0
     try:
         r = c0.get_object("resilient", "survivor")
         assert r.status == 200 and r.body == payload
         # Writes need disk quorum k+1=3 of 4 AND write-lock quorum 2 of
-        # 2 nodes — must FAIL with node 1 gone.
+        # 2 nodes — must FAIL with node 1 gone, as a RETRYABLE 503
+        # SlowDown (ref InsufficientWriteQuorum -> ErrSlowDown,
+        # cmd/api-errors.go:1898).
         r = c0.put_object("resilient", "blocked", b"x" * 1000)
-        assert r.status == 500
+        assert r.status == 503, r.status
     finally:
+        for s, t in zip(nodes[0].layer.pools[0].sets, old_timeouts):
+            s.ns_lock.default_timeout = t
         # Restart node 1's HTTP on the same port for later tests.
         srv, reg = servers[1]
         new_srv = S3Server(None, ACCESS, SECRET, rpc_registry=reg)

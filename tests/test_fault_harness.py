@@ -211,7 +211,9 @@ def test_wipe_restart_autoheal_converges(cluster):
     cluster.start_node(1)
 
     # Auto-heal (0.5s monitor interval) must restore every shard file.
-    deadline = time.time() + 90
+    # Generous deadline: under full-suite CPU contention the subprocess
+    # cluster + monitor loop can be starved for long stretches.
+    deadline = time.time() + 180
     while time.time() < deadline:
         counts = {k: len(_shard_files(cluster.disk_dirs(1),
                                       "fault-wipe", k))
