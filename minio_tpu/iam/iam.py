@@ -163,6 +163,9 @@ class IAMSys:
             self.policies = policies
             self.policy_docs = policy_docs
             self.groups = groups
+            self.sts_policy_map = (
+                self.store.load(f"{IAM_PREFIX}/sts-policy-map.json")
+                or {})
 
     def _notify_peers(self) -> None:
         if self.notify is not None:
@@ -259,30 +262,41 @@ class IAMSys:
 
     # -- STS ------------------------------------------------------------
 
+    def _mint_temp_credentials(self, claims: dict, parent: str,
+                               duration_seconds: int,
+                               policies: list[str] | None = None,
+                               session_policy: dict | None = None,
+                               ) -> UserIdentity:
+        """Shared STS tail: clamp duration, mint keys, sign the session
+        token, persist so every cluster node honors the credential (ref
+        STS creds stored in the IAM object store)."""
+        duration_seconds = max(900, min(duration_seconds, 7 * 24 * 3600))
+        exp = time.time() + duration_seconds
+        tmp_access = "MTPU" + secrets.token_hex(8).upper()
+        tmp_secret = secrets.token_urlsafe(24)
+        token = self._sign_token(
+            dict(claims, exp=exp, secret=tmp_secret))
+        u = UserIdentity(tmp_access, tmp_secret,
+                         policies=list(policies or []), parent=parent,
+                         session_token=token, expiration=exp,
+                         session_policy=session_policy)
+        with self._mu:
+            self.users[tmp_access] = u
+            self.store.save(f"{IAM_PREFIX}/users/{tmp_access}.json",
+                            u.to_dict())
+        return u
+
     def assume_role(self, access_key: str,
                     duration_seconds: int = 3600,
                     session_policy: dict | None = None) -> UserIdentity:
         """Mint temp credentials for an authenticated identity
         (ref AssumeRole, cmd/sts-handlers.go)."""
-        duration_seconds = max(900, min(duration_seconds, 7 * 24 * 3600))
-        exp = time.time() + duration_seconds
-        tmp_access = "MTPU" + secrets.token_hex(8).upper()
-        tmp_secret = secrets.token_urlsafe(24)
-        claims = {"parent": access_key, "exp": exp,
-                  "secret": tmp_secret}
+        claims: dict = {"parent": access_key}
         if session_policy:
             claims["policy"] = session_policy
-        token = self._sign_token(claims)
-        u = UserIdentity(tmp_access, tmp_secret, parent=access_key,
-                         session_token=token, expiration=exp,
-                         session_policy=session_policy)
-        with self._mu:
-            self.users[tmp_access] = u
-            # Persist so every cluster node honors the temp credential
-            # (ref STS creds stored in the IAM object store).
-            self.store.save(f"{IAM_PREFIX}/users/{tmp_access}.json",
-                            u.to_dict())
-        return u
+        return self._mint_temp_credentials(
+            claims, access_key, duration_seconds,
+            session_policy=session_policy)
 
     def assume_role_web_identity(self, subject: str, policy_name: str,
                                  duration_seconds: int = 3600,
@@ -293,21 +307,45 @@ class IAMSys:
         with self._mu:
             if policy_name not in self.policies:
                 raise KeyError(f"no such policy {policy_name!r}")
-        duration_seconds = max(900, min(duration_seconds, 7 * 24 * 3600))
-        exp = time.time() + duration_seconds
-        tmp_access = "MTPU" + secrets.token_hex(8).upper()
-        tmp_secret = secrets.token_urlsafe(24)
-        token = self._sign_token({"sub": subject, "exp": exp,
-                                  "secret": tmp_secret})
-        u = UserIdentity(tmp_access, tmp_secret,
-                         policies=[policy_name],
-                         parent=f"oidc:{subject}",
-                         session_token=token, expiration=exp)
+        return self._mint_temp_credentials(
+            {"sub": subject}, f"oidc:{subject}", duration_seconds,
+            policies=[policy_name])
+
+    def set_sts_policy_map(self, key: str, policies: list[str]) -> None:
+        """Map an external identity (``ldap:<user-dn>``, ``ldap:<group-dn>``
+        or ``oidc:<sub>``) to canned policies — the reference's policy
+        database for LDAP/OIDC STS identities (ref mc admin policy
+        attach --ldap; cmd/iam.go PolicyDBSet)."""
         with self._mu:
-            self.users[tmp_access] = u
-            self.store.save(f"{IAM_PREFIX}/users/{tmp_access}.json",
-                            u.to_dict())
-        return u
+            unknown = [p for p in policies if p not in self.policies]
+            if unknown:
+                raise KeyError(f"no such policy {unknown[0]!r}")
+            if policies:
+                self.sts_policy_map[key] = list(policies)
+            else:
+                self.sts_policy_map.pop(key, None)
+            self.store.save(f"{IAM_PREFIX}/sts-policy-map.json",
+                            self.sts_policy_map)
+        self._notify_peers()
+
+    def assume_role_ldap_identity(self, user_dn: str, groups: list[str],
+                                  duration_seconds: int = 3600,
+                                  ) -> UserIdentity:
+        """Temp credentials for an LDAP-authenticated identity; policies
+        come from the policy map over the user DN and group DNs (ref
+        AssumeRoleWithLDAPIdentity, cmd/sts-handlers.go:78-93). No
+        mapped policy = refused, like the reference."""
+        with self._mu:
+            names: list[str] = []
+            for key in [f"ldap:{user_dn}"] + [f"ldap:{g}" for g in groups]:
+                for p in self.sts_policy_map.get(key, []):
+                    if p not in names:
+                        names.append(p)
+        if not names:
+            raise KeyError(f"no policy mapped for {user_dn!r}")
+        return self._mint_temp_credentials(
+            {"ldapUser": user_dn}, f"ldap:{user_dn}", duration_seconds,
+            policies=names)
 
     def _sign_token(self, claims: dict) -> str:
         body = base64.urlsafe_b64encode(

@@ -226,6 +226,18 @@ class AdminHandlers:
         self._iam().delete_policy(p["name"])
         return {"ok": True}
 
+    def h_set_sts_policy_map(self, p, body):
+        """Map an external identity (ldap:<dn> / oidc:<sub>) to canned
+        policies (ref mc admin policy attach --ldap; PolicyDBSet).
+        Empty policies clears the mapping."""
+        doc = json.loads(body)
+        self._iam().set_sts_policy_map(doc["identity"],
+                                       doc.get("policies", []))
+        return {"ok": True}
+
+    def h_get_sts_policy_map(self, p, body):
+        return {"map": dict(self._iam().sts_policy_map)}
+
     def h_add_group(self, p, body):
         doc = json.loads(body)
         self._iam().add_group(doc["group"], doc.get("members", []),

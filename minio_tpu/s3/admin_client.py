@@ -72,6 +72,17 @@ class AdminClient:
                    {"accessKey": access_key,
                     "policies": ",".join(policies)})
 
+    def set_sts_policy_map(self, identity: str,
+                           policies: list[str]) -> None:
+        """Attach canned policies to an external STS identity
+        (``ldap:<dn>`` or ``oidc:<sub>``) — the `mc admin policy
+        attach --ldap` analog. Empty list clears the mapping."""
+        self._call("POST", "set-sts-policy-map", body=json.dumps({
+            "identity": identity, "policies": policies}).encode())
+
+    def get_sts_policy_map(self) -> dict:
+        return self._call("GET", "get-sts-policy-map")["map"]
+
     # -- heal -----------------------------------------------------------
 
     def heal(self, bucket: str = "", prefix: str = "",

@@ -2505,6 +2505,12 @@ class S3Server:
             # WebIdentity STS is unauthenticated: the TOKEN is the
             # credential (ref AssumeRoleWithWebIdentity handler).
             return self.sts_web_identity(req)
+        if (req.method == "POST" and not req.bucket
+                and b"AssumeRoleWithLDAPIdentity" in req.body):
+            # LDAP STS is unauthenticated: the directory password is
+            # the credential (ref AssumeRoleWithLDAPIdentity,
+            # cmd/sts-handlers.go:78-93).
+            return self.sts_ldap_identity(req)
         access_key = self.authenticate(req)
         req.access_key = access_key  # audit/trace attribution
         m, bucket, key, p = req.method, req.bucket, req.key, req.params
@@ -2719,29 +2725,46 @@ class S3Server:
         return S3Response(200, root.tobytes(),
                           {"Content-Type": "application/xml"})
 
-    def sts_web_identity(self, req: S3Request) -> S3Response:
-        """AssumeRoleWithWebIdentity: validate the bearer JWT against
-        the configured OpenID secret and mint temp creds carrying the
-        token's policy claim (ref cmd/sts-handlers.go; this build
-        validates HS256 against MINIO_IDENTITY_OPENID_SECRET instead
-        of fetching an RSA JWKS — no egress in this environment)."""
+    def _openid_validator(self):
+        """Per-server cached OpenID validator, rebuilt when the
+        identity env config changes (tests reconfigure between
+        servers; the JWKS cache must survive across requests)."""
         import os as _os
+        sig = tuple(_os.environ.get(k, "") for k in (
+            "MINIO_IDENTITY_OPENID_JWKS_URL",
+            "MINIO_IDENTITY_OPENID_SECRET",
+            "MINIO_IDENTITY_OPENID_CLIENT_ID",
+            "MINIO_IDENTITY_OPENID_CLAIM_NAME"))
+        cached = getattr(self, "_oidc_cache", None)
+        if cached is None or cached[0] != sig:
+            from ..iam.oidc import OpenIDValidator
+            self._oidc_cache = (sig, OpenIDValidator.from_env())
+        return self._oidc_cache[1]
 
-        from .webrpc import WebError, jwt_verify
+    def sts_web_identity(self, req: S3Request) -> S3Response:
+        """AssumeRoleWithWebIdentity: validate the bearer JWT — RS256
+        against the provider's JWKS (MINIO_IDENTITY_OPENID_JWKS_URL;
+        ref cmd/config/identity/openid/jwks.go:30), or HS256 against
+        MINIO_IDENTITY_OPENID_SECRET as an explicit dev mode — and mint
+        temp creds carrying the token's policy claim (ref
+        cmd/sts-handlers.go AssumeRoleWithWebIdentity)."""
+        from ..iam.oidc import OIDCError
         form = dict(urllib.parse.parse_qsl(
             req.body.decode("utf-8", "replace")))
         if form.get("Action") != "AssumeRoleWithWebIdentity":
             raise s3err.ERR_NOT_IMPLEMENTED
-        secret = _os.environ.get("MINIO_IDENTITY_OPENID_SECRET", "")
-        if not secret or self.iam is None:
+        validator = self._openid_validator()
+        if validator is None or self.iam is None:
             raise s3err.ERR_NOT_IMPLEMENTED
-        token = form.get("WebIdentityToken", "")
         try:
-            claims = jwt_verify(token, secret)
-        except WebError:
+            claims = validator.validate(form.get("WebIdentityToken", ""))
+        except OIDCError:
             raise s3err.ERR_ACCESS_DENIED
+        except Exception:
+            # JWKS endpoint unreachable: auth cannot be decided.
+            raise s3err.ERR_SLOW_DOWN
         subject = claims.get("sub", "")
-        policy_name = claims.get("policy", "")
+        policy_name = claims.get(validator.claim_name, "")
         if not subject or not policy_name:
             raise s3err.ERR_ACCESS_DENIED
         try:
@@ -2762,6 +2785,51 @@ class S3Server:
         c.child("SessionToken", cred.session_token)
         c.child("Expiration", _iso8601(cred.expiration))
         result.child("SubjectFromWebIdentityToken", subject)
+        return S3Response(200, root.tobytes(),
+                          {"Content-Type": "application/xml"})
+
+    def sts_ldap_identity(self, req: S3Request) -> S3Response:
+        """AssumeRoleWithLDAPIdentity: authenticate the username and
+        password against the configured directory (lookup-bind mode)
+        and mint temp creds carrying the policies mapped to the user's
+        DN / group DNs (ref cmd/sts-handlers.go:78-93,
+        cmd/config/identity/ldap/)."""
+        import os as _os
+
+        from ..iam.ldap import LDAPError, LDAPIdentity
+        form = dict(urllib.parse.parse_qsl(
+            req.body.decode("utf-8", "replace")))
+        if form.get("Action") != "AssumeRoleWithLDAPIdentity":
+            raise s3err.ERR_NOT_IMPLEMENTED
+        ldap = getattr(self, "ldap_identity", None) \
+            or LDAPIdentity.from_env(_os.environ)
+        if ldap is None or self.iam is None:
+            raise s3err.ERR_NOT_IMPLEMENTED
+        try:
+            duration = int(form.get("DurationSeconds", "3600"))
+        except ValueError:
+            raise s3err.ERR_INVALID_ARGUMENT
+        try:
+            user_dn, groups = ldap.authenticate(
+                form.get("LDAPUsername", ""),
+                form.get("LDAPPassword", ""))
+            cred = self.iam.assume_role_ldap_identity(
+                user_dn, groups, duration)
+        except LDAPError:
+            raise s3err.ERR_ACCESS_DENIED
+        except KeyError:
+            raise s3err.ERR_ACCESS_DENIED
+        except OSError:
+            raise s3err.ERR_SLOW_DOWN  # directory unreachable
+        ns = "https://sts.amazonaws.com/doc/2011-06-15/"
+        root = Element("AssumeRoleWithLDAPIdentityResponse", ns)
+        result = root.child("AssumeRoleWithLDAPIdentityResult")
+        c = result.child("Credentials")
+        c.child("AccessKeyId", cred.access_key)
+        c.child("SecretAccessKey", cred.secret_key)
+        c.child("SessionToken", cred.session_token)
+        c.child("Expiration", _iso8601(cred.expiration))
+        result.child("LDAPUserDN", user_dn)
         return S3Response(200, root.tobytes(),
                           {"Content-Type": "application/xml"})
 

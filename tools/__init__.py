@@ -1,0 +1,1 @@
+"""Operator tools: device benches, the round-long TPU watcher, tuning."""

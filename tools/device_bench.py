@@ -1,0 +1,136 @@
+"""Run every device-dependent bench config on a live accelerator.
+
+This is the single place where jax is allowed to touch the TPU relay:
+`bench.py` (and `tools/device_watch.py`) run it as a SUBPROCESS with a
+hard timeout, so a relay that hangs mid-measurement can never wedge the
+bench itself (which it did twice in round 4 when jax.devices() was
+called in-process).
+
+Prints ONE JSON line:
+  {"ok": true, "north_star": {...}, "configs": [...], "tune": {...}}
+or {"ok": false, "error": "..."} — always valid JSON on stdout, progress
+on stderr.
+
+Measured here (all device-asserted via ops.batching STATS deltas):
+  - north-star kernel roundtrip (8+4/1MiB encode+decode marginal GiB/s)
+  - ec8+4 encode + HighwayHash bitrot verify (device HH256 kernel)
+  - ec8+4 GetObject with 2 shards lost, through the engine
+  - ec16+4 full-disk heal, through the engine
+  - Pallas-vs-XLA tile sweep + device HH throughput (tools/tpu_tune.py)
+
+Reference harness being beaten: cmd/erasure-encode_test.go:209-247,
+cmd/erasure-decode_test.go:344, cmd/benchmark-utils_test.go.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _progress(msg: str) -> None:
+    print(f"[device-bench +{time.monotonic() - _T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+_T0 = time.monotonic()
+
+
+def run() -> dict:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    # Persistent compile cache: relay compiles cost tens of seconds;
+    # share them with bench.py and across watcher re-runs.
+    try:
+        cache_dir = os.environ.get(
+            "MINIO_TPU_JIT_CACHE",
+            os.path.join(os.path.expanduser("~"), ".cache",
+                         "minio_tpu_jit"))
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+    devs = jax.devices()
+    if not any(d.platform != "cpu" for d in devs):
+        return {"ok": False, "error": "no accelerator visible"}
+    platform = next(d.platform for d in devs if d.platform != "cpu")
+
+    import bench
+    from minio_tpu.ops import rs_tpu
+
+    out: dict = {"ok": True, "platform": platform,
+                 "n_devices": len(devs)}
+    errors: dict[str, str] = {}
+
+    _progress("north star kernel (device)")
+    try:
+        tpu_gibs, cpu_gibs = bench.bench_kernel_north_star(
+            np, jnp, rs_tpu, device=True)
+        out["north_star"] = {
+            "value": round(tpu_gibs, 3), "unit": "GiB/s",
+            "vs_host_native": round(tpu_gibs / max(cpu_gibs, 1e-9), 2),
+            "host_native_GiBs": round(cpu_gibs, 3),
+            "kernel": "pallas" if rs_tpu._pallas_enabled() else "xla",
+        }
+    except Exception as exc:  # noqa: BLE001
+        errors["north_star"] = f"{type(exc).__name__}: {exc}"
+
+    configs: list[dict] = []
+    workdir = tempfile.mkdtemp(prefix="minio-tpu-devbench-")
+    try:
+        for name, fn in (
+                ("encode_verify",
+                 lambda: bench.bench_encode_verify(np, True)),
+                ("get_2lost",
+                 lambda: bench.bench_get_with_loss(np, workdir, True)),
+                ("heal", lambda: bench.bench_heal(np, workdir, True))):
+            _progress(f"config {name} (device)")
+            res, err = bench._retrying(fn, name, attempts=2,
+                                       base_sleep=1.0)
+            if res is not None:
+                res["device_asserted"] = True
+                configs.append(res)
+            else:
+                errors[name] = err or "unknown"
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    out["configs"] = configs
+
+    _progress("tile sweep + device HH (tpu_tune)")
+    try:
+        from tools import tpu_tune
+        out["tune"] = tpu_tune.run()
+    except Exception as exc:  # noqa: BLE001
+        errors["tune"] = f"{type(exc).__name__}: {exc}"
+
+    from minio_tpu.ops import batching
+    out["stats"] = batching.STATS.snapshot()
+    out["hh_stats"] = batching.HH_STATS.snapshot()
+    if errors:
+        out["errors"] = errors
+    return out
+
+
+def main() -> None:
+    try:
+        out = run()
+    except BaseException as exc:  # noqa: BLE001 - one JSON line, always
+        out = {"ok": False, "error": f"{type(exc).__name__}: {exc}"}
+    print(json.dumps(out))
+    sys.exit(0 if out.get("ok") else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -29,14 +29,14 @@ def _pipelined(launch, sync, n1=4, n2=20):
     return max(t2 - t1, 1e-9) / (n2 - n1)
 
 
-def main() -> None:
+def run() -> dict:
+    """Measure and return the tuning dict (raises without accelerator)."""
     import numpy as np
     import jax
     import jax.numpy as jnp
 
     if not any(d.platform != "cpu" for d in jax.devices()):
-        print(json.dumps({"error": "no accelerator visible"}))
-        sys.exit(1)
+        raise RuntimeError("no accelerator visible")
 
     from minio_tpu.ops import rs_pallas, rs_tpu
 
@@ -96,7 +96,15 @@ def main() -> None:
     t = time.perf_counter() - t0
     out["hh_GiBs"] = round(chunks.nbytes / t / (1 << 30), 2)
     out["hh_warm_s"] = round(warm, 1)
+    return out
 
+
+def main() -> None:
+    try:
+        out = run()
+    except Exception as exc:  # noqa: BLE001
+        print(json.dumps({"error": f"{type(exc).__name__}: {exc}"}))
+        sys.exit(1)
     print(json.dumps(out))
 
 
