@@ -132,19 +132,40 @@ class BucketTargetSys:
         return ep
 
     def set_target(self, bucket: str, endpoint: str, target_bucket: str,
-                   access_key: str, secret_key: str) -> str:
+                   access_key: str, secret_key: str,
+                   bandwidth_limit: int = 0) -> str:
         """Register a target, returns its ARN (ref SetBucketTarget +
-        generateTargetArn)."""
+        generateTargetArn). bandwidth_limit: replication bytes/sec cap
+        toward this target, 0 = unlimited (ref BucketBandwidth /
+        pkg/bandwidth LimitInBytesPerSecond)."""
         endpoint = self.normalize_endpoint(endpoint)
+        if bandwidth_limit < 0:
+            raise ValueError("bandwidth_limit must be >= 0")
         arn = f"arn:minio:replication::{uuid.uuid4().hex[:8]}:{target_bucket}"
         targets = list(self.bucket_meta.get(bucket).replication_targets)
         targets.append({
             "arn": arn, "endpoint": endpoint,
             "target_bucket": target_bucket,
             "access_key": access_key, "secret_key": secret_key,
+            "bandwidth_limit": int(bandwidth_limit),
         })
         self.bucket_meta.update(bucket, replication_targets=targets)
         return arn
+
+    def set_target_bandwidth(self, bucket: str, arn: str,
+                             bandwidth_limit: int) -> None:
+        """Update a registered target's replication rate cap (0 lifts
+        it) — the `mc admin bucket remote edit --bandwidth` analog."""
+        if bandwidth_limit < 0:
+            raise ValueError("bandwidth_limit must be >= 0")
+        targets = list(self.bucket_meta.get(bucket).replication_targets)
+        for t in targets:
+            if t["arn"] == arn:
+                t["bandwidth_limit"] = int(bandwidth_limit)
+                self.bucket_meta.update(bucket,
+                                        replication_targets=targets)
+                return
+        raise KeyError(f"no such target {arn}")
 
     def list_targets(self, bucket: str) -> list[dict]:
         return list(self.bucket_meta.get(bucket).replication_targets)
@@ -200,8 +221,9 @@ class ReplicationPool:
         self.layer = layer
         self._q: queue.Queue[ReplicationTask | None] = queue.Queue()
         self.stats = {"replicated_count": 0, "replicated_bytes": 0,
-                      "failed_count": 0}
+                      "failed_count": 0, "throttled_count": 0}
         self._cfg_cache: dict[str, ReplicationConfig] = {}
+        self._limiters: dict[str, tuple[int, object]] = {}  # arn->(bps, bucket)
         self._stats_mu = threading.Lock()
         self._workers = [
             threading.Thread(target=self._work, daemon=True,
@@ -303,6 +325,7 @@ class ReplicationPool:
             return
 
         data, info = self.reader(task.bucket, task.key, task.version_id)
+        self._throttle(target, len(data))
         headers = {META_REPLICATION_STATUS: REPLICA}
         headers["content-type"] = info.metadata.get(
             "content-type", "application/octet-stream")
@@ -317,6 +340,25 @@ class ReplicationPool:
             self.stats["replicated_count"] += 1
             self.stats["replicated_bytes"] += len(data)
         self._set_status(task, COMPLETED)
+
+    def _throttle(self, target: dict, nbytes: int) -> None:
+        """Per-target token-bucket pacing (ref pkg/bandwidth
+        LimitInBytesPerSecond wired into replication transfers): a
+        capped target drains at ~its limit while uncapped targets
+        proceed at full speed; workers on other targets are unaffected
+        because each ARN has its own bucket."""
+        limit = int(target.get("bandwidth_limit") or 0)
+        if limit <= 0:
+            return
+        from ..utils.bandwidth import TokenBucket
+        arn = target["arn"]
+        with self._stats_mu:
+            cur = self._limiters.get(arn)
+            if cur is None or cur[0] != limit:
+                cur = (limit, TokenBucket(limit))
+                self._limiters[arn] = cur
+            self.stats["throttled_count"] += 1
+        cur[1].throttle(nbytes)
 
     def _set_status(self, task: ReplicationTask, status: str) -> None:
         if task.op == "delete":

@@ -401,6 +401,34 @@ class NewDiskMonitor:
     def _resweep_after(self) -> float:
         return max(self.interval * 4, 5.0)
 
+    def _heal_format(self, i: int, disk) -> bool:
+        """Restore a hot-swapped disk's format.json from a healthy set
+        peer (ref HealFormat, cmd/erasure-sets.go — the reference
+        re-stamps blank replacement drives without a restart; our boot
+        path only does this at init_or_load_formats time). The engine's
+        disk order IS the format row order, so slot i's uuid is row[i]
+        of whichever set row contains a healthy peer's uuid."""
+        from ..storage.format import (FormatErasure, load_format,
+                                      save_format)
+        if load_format(disk) is not None:
+            return False
+        eng = self.healer.engine
+        for j, peer in enumerate(eng.disks):
+            if j == i:
+                continue
+            ref = load_format(peer)
+            if ref is None:
+                continue
+            pos = ref.find(ref.this)
+            if pos is None or pos[1] != j:
+                continue  # peer not in this set row at its slot: skip
+            row = ref.sets[pos[0]]
+            save_format(disk, FormatErasure(
+                ref.deployment_id, row[i], ref.sets,
+                ref.distribution_algo))
+            return True
+        return False
+
     def start(self) -> None:
         if self._thread is not None and self._thread.is_alive():
             return
@@ -438,6 +466,10 @@ class NewDiskMonitor:
             # over write locks.
             if not hasattr(disk, "root"):
                 continue
+            try:
+                self._heal_format(i, disk)
+            except Exception:
+                pass  # dead disk / no healthy peer: volumes check next
             try:
                 vols = set(disk.list_volumes())
             except Exception:

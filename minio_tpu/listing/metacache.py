@@ -58,6 +58,15 @@ class MetacacheManager:
         self._caches: dict[tuple[str, str], _Cache] = {}
         self.scans = 0  # observability: number of real disk scans
         self.last_persist: threading.Thread | None = None
+        # Cluster sharing (ref updateMetacacheListing routing,
+        # cmd/metacache-set.go:247, cmd/metacache-bucket.go): in
+        # distributed mode the cluster wiring installs a
+        # rpc.peer.MetacacheShare here plus this manager's (pool, set)
+        # address; every (bucket, root) then has ONE owning node whose
+        # scan all nodes reuse, instead of N nodes doing N walks.
+        self.peer_share = None
+        self.share_id: tuple[int, int] = (0, 0)
+        self.peer_serves = 0  # served-from-peer counter (tests/metrics)
 
     # -- scan -------------------------------------------------------------
 
@@ -144,11 +153,55 @@ class MetacacheManager:
             return not tracker.changed_under(c.bucket, c.root, back)
         return False
 
-    def _entries_for(self, bucket: str, prefix: str) -> list[dict]:
-        """Serve entries covering `prefix`, scanning if needed. Caches
-        are registered per prefix-root (first path segment, like the
-        reference's per-prefix metacache id selection)."""
+    def _entries_for(self, bucket: str, prefix: str, after: str = ""):
+        """Entries covering `prefix`, name > `after` when peer-served
+        (iterable, sorted by name): local cache/scan when this node
+        owns the (bucket, root), a paged peer stream when another node
+        does. `after` (the caller's pagination marker) seeds the
+        owner-side cursor so page k of a paginated listing pulls one
+        page over the wire, not k pages."""
         root = prefix.split("/", 1)[0] if "/" in prefix else ""
+        share = self.peer_share
+        if share is not None:
+            owner = share.owner_key(bucket, root)
+            if owner is not None:
+                return self._peer_then_local(share, owner, bucket,
+                                             root, after)
+        return self._entries_local(bucket, root)
+
+    def _peer_then_local(self, share, owner: str, bucket: str,
+                         root: str, after: str):
+        """Stream the owner's entries; on ANY transport failure —
+        first page or mid-stream — continue from a local scan at the
+        last yielded name, so an owner crash degrades a listing to a
+        local walk instead of failing it (availability beats the
+        shared-scan optimization)."""
+        last = after
+        it = share.fetch_entries(owner, self.share_id, bucket, root,
+                                 after=after)
+        served = False
+        while True:
+            try:
+                e = next(it)
+            except StopIteration:
+                return
+            except Exception:
+                for e2 in self._entries_local(bucket, root):
+                    if e2["name"] > last:
+                        yield e2
+                return
+            if not served:
+                served = True
+                self.peer_serves += 1
+            last = e["name"]
+            yield e
+
+    def _entries_local(self, bucket: str, root: str) -> list[dict]:
+        """Serve entries from this node's cache, scanning if stale.
+        Caches are registered per prefix-root (first path segment, like
+        the reference's per-prefix metacache id selection). This is
+        also what the peer RPC serves to non-owner nodes — it must
+        never delegate back out."""
         key = (bucket, root)
         tracker = getattr(self.engine, "update_tracker", None)
         counter = tracker.bucket_counter(bucket) if tracker else -1
@@ -189,7 +242,7 @@ class MetacacheManager:
                   max_keys: int = 1000) -> list[FileInfo]:
         """Latest live version per key (ListObjects view)."""
         out: list[FileInfo] = []
-        for e in self._entries_for(bucket, prefix):
+        for e in self._entries_for(bucket, prefix, after=marker):
             name = e["name"]
             if prefix and not name.startswith(prefix):
                 continue
@@ -214,7 +267,7 @@ class MetacacheManager:
         key boundaries (a key's versions are never split across pages;
         max_keys may be exceeded by the last key's version count)."""
         out: list[FileInfo] = []
-        for e in self._entries_for(bucket, prefix):
+        for e in self._entries_for(bucket, prefix, after=marker):
             name = e["name"]
             if prefix and not name.startswith(prefix):
                 continue

@@ -232,6 +232,20 @@ def build_cluster_node(disk_args: list[str], my_host: str, my_port: int,
         pools.append(sets)
 
     layer = ErasureServerPools(pools)
+
+    # Cluster-shared metacache: every (bucket, root) listing has one
+    # owning node; the others stream its cache over the peer plane
+    # instead of re-walking the set (ref owner-routed metacache,
+    # cmd/metacache-server-pool.go:38, cmd/metacache-set.go:247).
+    if distributed:
+        from .peer import MetacacheShare
+        share = MetacacheShare(notification, all_nodes & my_keys,
+                               sorted(all_nodes))
+        for pi, pool_sets in enumerate(layer.pools):
+            for si, s in enumerate(pool_sets.sets):
+                s.metacache.peer_share = share
+                s.metacache.share_id = (pi, si)
+
     return ClusterNode(layer, registry, local_disks, peers,
                        peer_service=peer_service,
                        notification=notification)

@@ -16,6 +16,11 @@ from ..storage.metadata import FileInfo
 from .transport import RPCClient
 
 
+# Entries per walk_dir RPC page: bounds both the frame size (~300B per
+# single-version entry -> ~300KiB pages) and server/client memory.
+WALK_PAGE_ENTRIES = 1000
+
+
 def _fi_to_wire(fi: FileInfo) -> dict:
     d = fi.to_version_dict()
     d["_volume"] = fi.volume
@@ -94,10 +99,22 @@ class StorageRPCService:
                                                   a["path"])}, b""
 
     def rpc_walk_dir(self, a, p):
-        # One RPC per disk per listing scan (ref WalkDir streamed over
-        # storage REST, cmd/metacache-walk.go).
-        return {"entries": self._disk(a).walk_dir(
-            a["volume"], a.get("prefix", ""))}, b""
+        # STREAMED walk: bounded pages with a resume token instead of
+        # the whole listing in one frame — a million-object bucket is
+        # many small frames, O(page) memory on both ends (ref WalkDir
+        # streamed over storage REST with trailing-error framing,
+        # cmd/metacache-walk.go, cmd/storage-rest-server.go:1025; the
+        # strict request/response transport here makes the resume
+        # token carry the stream position instead).
+        import itertools
+        limit = max(1, min(int(a.get("limit") or WALK_PAGE_ENTRIES),
+                           10 * WALK_PAGE_ENTRIES))
+        it = self._disk(a).walk_dir_iter(a["volume"],
+                                         a.get("prefix", ""),
+                                         a.get("after", ""))
+        entries = list(itertools.islice(it, limit + 1))
+        truncated = len(entries) > limit
+        return {"entries": entries[:limit], "truncated": truncated}, b""
 
     def rpc_rename_data(self, a, p):
         self._disk(a).rename_data(a["src_volume"], a["src_path"],
@@ -225,9 +242,23 @@ class RemoteStorage(StorageAPI):
         return self._call("list_dir", {"volume": volume,
                                        "path": path})[0]["entries"]
 
+    def walk_dir_iter(self, volume, prefix="", after=""):
+        # Streaming walk over the paged RPC: yield each page as it
+        # arrives; the resume token (last yielded name) makes every
+        # frame independent, so peak RPC frame size and client memory
+        # are O(page) regardless of bucket size.
+        while True:
+            res, _ = self._call("walk_dir", {
+                "volume": volume, "prefix": prefix, "after": after,
+                "limit": WALK_PAGE_ENTRIES})
+            entries = res["entries"]
+            yield from entries
+            if not res.get("truncated") or not entries:
+                return
+            after = entries[-1]["name"]
+
     def walk_dir(self, volume, prefix=""):
-        return self._call("walk_dir", {"volume": volume,
-                                       "prefix": prefix})[0]["entries"]
+        return list(self.walk_dir_iter(volume, prefix))
 
     def rename_data(self, src_volume, src_path, fi, dst_volume, dst_path):
         self._call("rename_data", {"src_volume": src_volume,

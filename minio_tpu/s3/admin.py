@@ -303,8 +303,18 @@ class AdminHandlers:
         doc = json.loads(body)
         arn = self._replication().targets.set_target(
             p["bucket"], doc["endpoint"], doc["target_bucket"],
-            doc["access_key"], doc["secret_key"])
+            doc["access_key"], doc["secret_key"],
+            bandwidth_limit=int(doc.get("bandwidth_limit") or 0))
         return {"arn": arn}
+
+    def h_set_target_bandwidth(self, p, body):
+        """Edit a target's replication rate cap (bytes/sec, 0 lifts
+        it) — `mc admin bucket remote edit --bandwidth` analog (ref
+        pkg/bandwidth LimitInBytesPerSecond)."""
+        doc = json.loads(body)
+        self._replication().targets.set_target_bandwidth(
+            p["bucket"], doc["arn"], int(doc["bandwidth_limit"]))
+        return {"ok": True}
 
     def h_list_remote_targets(self, p, body):
         targets = self._replication().targets.list_targets(p["bucket"])

@@ -137,13 +137,24 @@ class AdminClient:
 
     def set_remote_target(self, bucket: str, endpoint: str,
                           target_bucket: str, access_key: str,
-                          secret_key: str) -> str:
+                          secret_key: str,
+                          bandwidth_limit: int = 0) -> str:
         return self._call("POST", "set-remote-target",
                           {"bucket": bucket}, json.dumps({
                               "endpoint": endpoint,
                               "target_bucket": target_bucket,
                               "access_key": access_key,
-                              "secret_key": secret_key}).encode())["arn"]
+                              "secret_key": secret_key,
+                              "bandwidth_limit": bandwidth_limit,
+                          }).encode())["arn"]
+
+    def set_target_bandwidth(self, bucket: str, arn: str,
+                             bandwidth_limit: int) -> None:
+        """Replication bytes/sec cap for one target (0 lifts it)."""
+        self._call("POST", "set-target-bandwidth", {"bucket": bucket},
+                   json.dumps({"arn": arn,
+                               "bandwidth_limit": bandwidth_limit,
+                               }).encode())
 
     def list_remote_targets(self, bucket: str) -> list:
         return self._call("GET", "list-remote-targets",

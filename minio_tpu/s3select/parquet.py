@@ -8,10 +8,11 @@ Supported (flat schemas, the S3 Select case):
   - PLAIN encoding for BOOLEAN/INT32/INT64/FLOAT/DOUBLE/BYTE_ARRAY
   - RLE/bit-packed hybrid for definition levels and RLE_DICTIONARY
     indices (+ dictionary pages)
-  - UNCOMPRESSED pages (codecs raise a clear error)
+  - UNCOMPRESSED, SNAPPY (utils/snappy.py) and GZIP pages
   - OPTIONAL columns (nulls via def level 0)
-Writer emits one row group, PLAIN, uncompressed — enough for tests and
-for CONVERT-style tooling; reader handles dictionary-encoded files too.
+Writer emits one row group, PLAIN, optionally snappy/gzip-compressed —
+enough for tests and CONVERT-style tooling; reader handles
+dictionary-encoded files too.
 """
 
 from __future__ import annotations
@@ -27,7 +28,9 @@ BOOLEAN, INT32, INT64, INT96, FLOAT, DOUBLE, BYTE_ARRAY, FIXED = range(8)
 ENC_PLAIN, ENC_PLAIN_DICT, ENC_RLE = 0, 2, 3
 ENC_RLE_DICT = 8
 # Codec
-CODEC_UNCOMPRESSED, CODEC_SNAPPY = 0, 1
+CODEC_UNCOMPRESSED, CODEC_SNAPPY, CODEC_GZIP = 0, 1, 2
+_CODEC_NAMES = {None: CODEC_UNCOMPRESSED, "snappy": CODEC_SNAPPY,
+                "gzip": CODEC_GZIP}
 # Repetition
 REQUIRED, OPTIONAL, REPEATED = 0, 1, 2
 # PageType
@@ -280,8 +283,12 @@ def _plain_encode(ptype: int, values: list) -> bytes:
     raise ParquetError(f"unsupported type {ptype}")
 
 
-def write_parquet(columns: list[Column], rows: list[dict]) -> bytes:
-    """One row group, PLAIN, uncompressed."""
+def write_parquet(columns: list[Column], rows: list[dict],
+                  codec: str | None = None) -> bytes:
+    """One row group, PLAIN; codec None | "snappy" | "gzip" compresses
+    every data page (fixture generation + CONVERT tooling parity with
+    the reference's compressed-page support)."""
+    codec_id = _CODEC_NAMES[codec]
     out = bytearray(MAGIC)
     chunks = []
     for col in columns:
@@ -301,9 +308,17 @@ def write_parquet(columns: list[Column], rows: list[dict]) -> bytes:
             body += struct.pack("<I", len(lv)) + lv
         body += _plain_encode(col.ptype, values)
 
+        uncomp_len = len(body)
+        if codec_id == CODEC_SNAPPY:
+            from ..utils import snappy
+            body = bytearray(snappy.compress(bytes(body)))
+        elif codec_id == CODEC_GZIP:
+            import gzip as _gzip
+            body = bytearray(_gzip.compress(bytes(body)))
+
         ph = TWriter()
         ph.i32(1, PAGE_DATA)
-        ph.i32(2, len(body))
+        ph.i32(2, uncomp_len)
         ph.i32(3, len(body))
         ph.begin_struct(5)  # DataPageHeader
         ph.i32(1, len(rows))
@@ -315,7 +330,8 @@ def write_parquet(columns: list[Column], rows: list[dict]) -> bytes:
 
         off = len(out)
         out += bytes(ph.out) + body
-        chunks.append((col, off, len(ph.out) + len(body), len(rows)))
+        chunks.append((col, off, len(ph.out) + len(body), len(rows),
+                       len(ph.out) + uncomp_len))
 
     # FileMetaData footer (thrift list items are bare structs encoded
     # back-to-back — no field headers between them).
@@ -346,9 +362,9 @@ def write_parquet(columns: list[Column], rows: list[dict]) -> bytes:
     # RowGroup struct (list item: no field header)
     fm2._last.append(0)
     fm2.list_begin(1, CT_STRUCT, len(columns))  # columns
-    total = 0
-    for col, off, clen, nvals in chunks:
-        total += clen
+    total = 0  # RowGroup.total_byte_size is UNCOMPRESSED per the spec
+    for col, off, clen, nvals, uclen in chunks:
+        total += uclen
         fm2._last.append(0)  # ColumnChunk
         fm2.i64(2, off)  # file_offset
         fm2.begin_struct(3)  # ColumnMetaData
@@ -358,9 +374,9 @@ def write_parquet(columns: list[Column], rows: list[dict]) -> bytes:
         fm2.list_begin(3, CT_BINARY, 1)
         fm2.varint(len(col.name.encode()))
         fm2.out += col.name.encode()
-        fm2.i32(4, CODEC_UNCOMPRESSED)
+        fm2.i32(4, codec_id)
         fm2.i64(5, nvals)
-        fm2.i64(6, clen)
+        fm2.i64(6, uclen)
         fm2.i64(7, clen)
         fm2.i64(9, off)  # data_page_offset
         fm2.end_struct()
@@ -494,10 +510,30 @@ def _read_page_header(r: TReader) -> dict:
 
 
 def _decompress(codec: int, data: bytes, uncomp: int) -> bytes:
+    """Page decompression: UNCOMPRESSED, SNAPPY (raw block format,
+    utils/snappy.py) and GZIP — the codecs the reference's vendored
+    parquet stack supports (pkg/s3select/internal/parquet-go; real-
+    world parquet is nearly always snappy)."""
     if codec == CODEC_UNCOMPRESSED:
         return data
-    raise ParquetError(
-        f"unsupported parquet codec {codec} (only UNCOMPRESSED)")
+    if codec == CODEC_SNAPPY:
+        from ..utils import snappy
+        try:
+            out = snappy.decompress(data)
+        except snappy.SnappyError as e:
+            raise ParquetError(f"bad snappy page: {e}")
+    elif codec == CODEC_GZIP:
+        import zlib
+        try:
+            out = zlib.decompress(data, 47)  # gzip or zlib wrapper
+        except zlib.error as e:
+            raise ParquetError(f"bad gzip page: {e}")
+    else:
+        raise ParquetError(f"unsupported parquet codec {codec}")
+    if len(out) != uncomp:
+        raise ParquetError(
+            f"page inflated to {len(out)}, header says {uncomp}")
+    return out
 
 
 def read_parquet(data: bytes) -> tuple[list[Column], list[dict]]:

@@ -93,28 +93,52 @@ class StorageAPI(abc.ABC):
     def list_dir(self, volume: str, path: str) -> list[str]:
         """Entries of a directory; dirs have a trailing '/'."""
 
-    def walk_dir(self, volume: str, prefix: str = "") -> list[dict]:
-        """Stream this disk's view of a bucket, sorted by object name:
-        [{"name": ..., "versions": [version-dict, ...]}, ...]
-        (ref StorageAPI.WalkDir, cmd/metacache-walk.go — the per-disk
-        feeder of the metacache listing engine). Entries carry the full
-        xl.meta versions array so the merger can resolve quorum without
-        extra round trips. Remote disks override with a single RPC.
-        """
-        from . import errors as _serr
-        out: list[dict] = []
+    def walk_dir_iter(self, volume: str, prefix: str = "",
+                      after: str = ""):
+        """Ordered, RESUMABLE per-disk walk of a bucket — yields
+        {"name": ..., "versions": [version-dict, ...]} entries in
+        full-key BYTE order, one at a time, never materializing the
+        listing (ref StorageAPI.WalkDir, cmd/metacache-walk.go — the
+        per-disk feeder of the metacache listing engine; there the
+        stream rides one chunked HTTP response, here it feeds the paged
+        storage RPC in rpc/storage.py). Entries carry the full xl.meta
+        versions array so the merger can resolve quorum without extra
+        round trips.
 
-        def rec(path: str) -> None:
+        Ordering: a MIN-HEAP of pending directories, popped in path
+        order. An object's key equals its directory's path, a
+        directory's subtree only emits keys >= its path, and heap pops
+        are monotonic — so emission is exact byte order even where
+        depth-first sibling order disagrees with it ("a" < "a-b" <
+        "a/b" although sibling dirs sort "a-b/" < "a/"). Memory is
+        O(frontier), not O(listing). (The reference's walk emits
+        subtree-contiguous order instead; OUR listing contract — the
+        k-way merge, markers, golden listings — is byte order, so the
+        walk must produce it.)
+
+        `after` (exclusive) resumes a previous walk: directories whose
+        whole subtree sorts <= after are pruned without descending, so
+        a resumed page costs O(depth + page), not O(listing).
+        """
+        import heapq
+
+        from . import errors as _serr
+
+        heap: list[str] = [""]
+        while heap:
+            path = heapq.heappop(heap)
             try:
                 entries = self.list_dir(volume, path)
             except _serr.StorageError:
-                return
+                continue
             is_obj = "xl.meta" in entries
-            if is_obj and (not prefix or path.startswith(prefix)):
+            if is_obj and path and (not prefix
+                                    or path.startswith(prefix)) \
+                    and path > after:
                 try:
                     vers = [fi.to_version_dict()
                             for fi in self.read_versions(volume, path)]
-                    out.append({"name": path, "versions": vers})
+                    yield {"name": path, "versions": vers}
                 except _serr.StorageError:
                     pass
             for e in entries:
@@ -129,11 +153,20 @@ class StorageAPI(abc.ABC):
                 if prefix and not (sub.startswith(prefix)
                                    or prefix.startswith(sub + "/")):
                     continue
-                rec(sub)
+                # Resume pruning: every key in the subtree is either
+                # `sub` itself or starts with `sub + "/"`; skip unless
+                # some of those can sort after `after`.
+                if after and not (after < sub + "/"
+                                  or after.startswith(sub + "/")):
+                    continue
+                heapq.heappush(heap, sub)
 
-        rec("")
-        out.sort(key=lambda d: d["name"])
-        return out
+    def walk_dir(self, volume: str, prefix: str = "") -> list[dict]:
+        """Materialized walk_dir_iter (compat surface for callers that
+        want the whole listing; the sort is a no-op safety net — the
+        iterator already emits byte order)."""
+        return sorted(self.walk_dir_iter(volume, prefix),
+                      key=lambda d: d["name"])
 
     # --- object versions (xl.meta) ---
 

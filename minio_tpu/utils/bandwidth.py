@@ -60,3 +60,45 @@ class BandwidthMonitor:
                     "txRateBps": tx / WINDOW_SECONDS,
                 }
         return out
+
+
+class TokenBucket:
+    """Blocking byte-rate limiter (ref pkg/bandwidth/bandwidth.go:21
+    LimitInBytesPerSecond + MonitoredReader throttle): tokens refill
+    continuously at `rate_bps`; `throttle(n)` sleeps until n bytes may
+    pass. Burst defaults to one second of tokens, so an idle target
+    starts instantly but sustained drain converges to the limit."""
+
+    def __init__(self, rate_bps: float, burst: float | None = None):
+        if rate_bps <= 0:
+            raise ValueError("rate must be positive")
+        self.rate = float(rate_bps)
+        self.burst = float(burst if burst is not None else rate_bps)
+        self._tokens = self.burst
+        self._ts = time.monotonic()
+        self._mu = threading.Lock()
+
+    def _take(self, want: float) -> float:
+        """Take up to `want` tokens; returns seconds to sleep before
+        retrying (0 = got them)."""
+        with self._mu:
+            now = time.monotonic()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._ts) * self.rate)
+            self._ts = now
+            if self._tokens >= want:
+                self._tokens -= want
+                return 0.0
+            return (want - self._tokens) / self.rate
+
+    def throttle(self, nbytes: int) -> None:
+        """Block until `nbytes` may pass (chunks larger than the burst
+        are split internally so they can always eventually pass)."""
+        remaining = float(nbytes)
+        while remaining > 0:
+            want = min(remaining, self.burst)
+            wait = self._take(want)
+            if wait > 0:
+                time.sleep(wait)
+                continue
+            remaining -= want

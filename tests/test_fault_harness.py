@@ -302,3 +302,46 @@ def test_full_node_outage_degraded_io_then_rejoin(cluster):
     cluster.start_node(2)
     g = cluster.client(2).get_object("fault-degraded", "during")
     assert g.status == 200 and g.body == during
+
+
+def test_hot_single_drive_swap_heals_without_restart(cluster):
+    """Replace ONE drive under a RUNNING node — no restart, no manual
+    heal call: the node's own new-disk monitor must re-stamp the
+    drive's format.json and re-populate every shard (ref
+    verify-healing.sh:31-63 drive replacement +
+    cmd/background-newdisks-heal-ops.go:113; format re-stamp parity
+    with HealFormat, cmd/erasure-sets.go)."""
+    c = cluster.client(0)
+    assert c.make_bucket("fault-swap").status == 200
+    bodies = {f"s{i}": os.urandom(250_000) for i in range(5)}
+    for k, b in bodies.items():
+        _put_ok(c, "fault-swap", k, b)
+    target = cluster.disk_dirs(2)[0]
+    # Every disk holds one shard per object (6 disks, EC 3+3).
+    assert all(len(_shard_files([target], "fault-swap", k)) == 1
+               for k in bodies)
+
+    shutil.rmtree(target)          # hot drive swap: node keeps running
+    os.makedirs(target)
+
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        counts = {k: len(_shard_files([target], "fault-swap", k))
+                  for k in bodies}
+        if all(n == 1 for n in counts.values()):
+            break
+        time.sleep(1)
+    else:
+        pytest.fail(f"hot-swap heal did not converge: {counts}")
+
+    # The monitor restored the drive's identity too, not just data:
+    # format.json is back (a later restart depends on it).
+    fmt = os.path.join(target, ".minio.sys", "format.json")
+    assert os.path.exists(fmt)
+    with open(fmt) as f:
+        assert json.load(f)["xl"]["this"]
+    for i in range(N_NODES):
+        ci = cluster.client(i)
+        for k, b in bodies.items():
+            g = ci.get_object("fault-swap", k)
+            assert g.status == 200 and g.body == b, (i, k)

@@ -256,3 +256,85 @@ def test_remote_walk_dir(tmp_path):
     remote = RemoteStorage(_LoopClient(), local.root)
     assert remote.walk_dir("rb") == local.walk_dir("rb")
     assert remote.walk_dir("rb", "x/") == local.walk_dir("rb", "x/")
+
+
+def test_walk_dir_iter_order_and_resume(engine):
+    """The streaming walk emits full-key byte order (the '-' < '/'
+    edge included) and `after` resumes exactly (ref metacache-walk.go
+    ordering contract)."""
+    engine.make_bucket("ob")
+    names = ["ab-x", "ab/c", "ab/d/e", "abc", "a", "z/9"]
+    for n in names:
+        engine.put_object("ob", n, b"x")
+    disk = engine.disks[0]
+    got = [e["name"] for e in disk.walk_dir_iter("ob")]
+    assert got == sorted(names)
+    assert got == [e["name"] for e in disk.walk_dir("ob")]
+    for i, cut in enumerate(got):
+        resumed = [e["name"] for e in disk.walk_dir_iter("ob",
+                                                         after=cut)]
+        assert resumed == got[i + 1:], cut
+
+
+def test_remote_walk_dir_streams_pages(tmp_path, monkeypatch):
+    """A >10k-object bucket crosses the RPC as many bounded pages, not
+    one giant frame (round-4 verdict missing #3; ref WalkDir streaming,
+    cmd/storage-rest-server.go:1025)."""
+    from minio_tpu.rpc import storage as rpcstorage
+    from minio_tpu.rpc.storage import RemoteStorage, StorageRPCService
+
+    local = XLStorage(str(tmp_path / "disk"))
+    eng = ErasureObjects([local, XLStorage(str(tmp_path / "peer"))])
+    eng.make_bucket("big")
+    eng.put_object("big", "seed", b"s")
+    raw = local.read_all("big", "seed/xl.meta")
+    names = [f"d{i % 100:02d}/obj-{i:05d}" for i in range(10_050)]
+    for n in names:
+        local.write_all("big", f"{n}/xl.meta", raw)
+
+    svc = StorageRPCService({local.root: local})
+    frames = []
+
+    class _LoopClient:
+        def call(self, service, method, args, payload=b""):
+            res, body = getattr(svc, f"rpc_{method}")(args, payload)
+            frames.append(len(json.dumps(res)))
+            return res, body
+
+    remote = RemoteStorage(_LoopClient(), local.root)
+    it = remote.walk_dir_iter("big")
+    first = next(it)          # entries arrive before the walk finishes
+    assert frames and frames[0] > 0
+    got = [first["name"]] + [e["name"] for e in it]
+    assert got == sorted(names + ["seed"])
+    # ~11 pages of <=1000 entries; every frame bounded, none giant
+    # (one frame with all 10k entries would be ~10x this cap).
+    assert len(frames) >= 11
+    assert max(frames) < rpcstorage.WALK_PAGE_ENTRIES * 600
+    # Prefix walks page through the same path.
+    sub = [e["name"] for e in remote.walk_dir_iter("big", "d07/")]
+    assert sub == [n for n in sorted(names) if n.startswith("d07/")]
+
+
+def test_remote_walk_page_boundary_prefix_keys(tmp_path, monkeypatch):
+    """Regression: keys 'a' and 'a-b' (sibling dirs sort 'a-b/' < 'a/'
+    but keys sort 'a' < 'a-b') must both survive a page boundary —
+    a DFS-ordered walk dropped 'a' when the resume token was 'a-b'."""
+    from minio_tpu.rpc import storage as rpcstorage
+    from minio_tpu.rpc.storage import RemoteStorage, StorageRPCService
+
+    local = XLStorage(str(tmp_path / "disk"))
+    eng = ErasureObjects([local, XLStorage(str(tmp_path / "peer"))])
+    eng.make_bucket("pb")
+    for name in ["a", "a-b", "a/c", "a.d"]:
+        eng.put_object("pb", name, b"x")
+    monkeypatch.setattr(rpcstorage, "WALK_PAGE_ENTRIES", 1)
+    svc = StorageRPCService({local.root: local})
+
+    class _LoopClient:
+        def call(self, service, method, args, payload=b""):
+            return getattr(svc, f"rpc_{method}")(args, payload)
+
+    remote = RemoteStorage(_LoopClient(), local.root)
+    got = [e["name"] for e in remote.walk_dir_iter("pb")]
+    assert got == sorted(["a", "a-b", "a/c", "a.d"])

