@@ -111,6 +111,21 @@ def _hash_rows_device(stacked, total_bytes: int, n_requests: int):
         return None
 
 
+def _host_digest_many(algo: str, streams: list[bytes],
+                      chunk_size: int) -> list[list[bytes]]:
+    """Host path of digest_chunks_many: on multicore hosts the k+m
+    shards hash in parallel — the native HighwayHash kernel releases
+    the GIL, so the fan-out is real concurrency."""
+    from ..parallel.quorum import MULTICORE, parallel_map
+    if len(streams) > 1 and MULTICORE:
+        results, errs = parallel_map(
+            [lambda s=s: digest_chunks(algo, s, chunk_size)
+             for s in streams])
+        if not any(e is not None for e in errs):
+            return results
+    return [digest_chunks(algo, s, chunk_size) for s in streams]
+
+
 def digest_chunks_many(algo: str, streams: list[bytes], chunk_size: int,
                        ) -> list[list[bytes]]:
     """Per-stream chunk digests, with all full chunks of all streams
@@ -125,7 +140,7 @@ def digest_chunks_many(algo: str, streams: list[bytes], chunk_size: int,
     full_counts = [len(s) // chunk_size for s in streams]
     total_full = sum(full_counts) * chunk_size
     if not _device_hash_ok(algo, chunk_size, total_full):
-        return [digest_chunks(algo, s, chunk_size) for s in streams]
+        return _host_digest_many(algo, streams, chunk_size)
 
     import numpy as np
     stacked = np.empty((sum(full_counts), chunk_size), dtype=np.uint8)
@@ -138,7 +153,7 @@ def digest_chunks_many(algo: str, streams: list[bytes], chunk_size: int,
             row += nf
     digs = _hash_rows_device(stacked, total_full, len(streams))
     if digs is None:
-        return [digest_chunks(algo, s, chunk_size) for s in streams]
+        return _host_digest_many(algo, streams, chunk_size)
 
     out: list[list[bytes]] = []
     row = 0

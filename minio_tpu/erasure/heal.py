@@ -220,7 +220,6 @@ class Healer:
         # shards: one decode per block, shared mask across the whole
         # object (the best TPU batch source).
         shard_size = fi.erasure.shard_size()
-        use = good_disks[:k]
         missing_shards = sorted(shard_of_disk[i] for i in bad)
         codec = Erasure(k, m, fi.erasure.block_size)
         from ..storage.metadata import ObjectPartInfo
@@ -229,11 +228,24 @@ class Healer:
         # rebuilt[part_number][shard_idx] -> bytes
         rebuilt: dict[int, dict[int, bytearray]] = {}
         for part in parts:
+            # Collect k survivor streams, tolerating read failures from
+            # disks that were "ok" at classify time but dropped since
+            # (a peer restarting mid-sweep): any k good shards decode;
+            # only fewer than k is fatal for this object.
             streams = {}
-            for i in use:
-                streams[shard_of_disk[i]] = eng.disks[i].read_all(
-                    bucket,
-                    f"{object_name}/{fi.data_dir}/part.{part.number}")
+            for i in good_disks:
+                if len(streams) == k:
+                    break
+                try:
+                    streams[shard_of_disk[i]] = eng.disks[i].read_all(
+                        bucket,
+                        f"{object_name}/{fi.data_dir}/part.{part.number}")
+                except serr.StorageError:
+                    continue
+            if len(streams) < k:
+                raise serr.FaultyDisk(
+                    f"heal {bucket}/{object_name}: only "
+                    f"{len(streams)}/{k} survivor shards readable")
             algo = bitrot.DEFAULT_ALGORITHM
             for cs in fi.erasure.checksums:
                 if cs.get("part") == part.number:
@@ -367,7 +379,19 @@ class Healer:
             bucket = binfo["name"]
             self.heal_bucket(bucket)
             for obj in eng.list_objects(bucket, max_keys=1_000_000):
-                r = self.heal_object_or_queue(bucket, obj.name)
+                # Per-object isolation: one failing object (lock
+                # timeout, peer flapping mid-sweep) must not abort the
+                # rest of the sweep — it starved convergence when an
+                # early object kept failing while later ones never got
+                # reached; the next sweep retries it anyway.
+                try:
+                    r = self.heal_object_or_queue(bucket, obj.name)
+                except Exception as exc:  # noqa: BLE001 — sweep survives
+                    import logging
+                    logging.getLogger("minio_tpu.heal").warning(
+                        "heal sweep: %s/%s failed: %r", bucket,
+                        obj.name, exc)
+                    continue
                 if disk_index in r.healed_disks or not r.healed_disks:
                     results.append(r)
         return results
@@ -412,21 +436,30 @@ class NewDiskMonitor:
                                       save_format)
         if load_format(disk) is not None:
             return False
+        import logging
+        log = logging.getLogger("minio_tpu.heal")
         eng = self.healer.engine
         for j, peer in enumerate(eng.disks):
             if j == i:
                 continue
             ref = load_format(peer)
             if ref is None:
+                log.debug("restamp probe: peer %d (%s) format "
+                          "unreadable", j, peer)
                 continue
             pos = ref.find(ref.this)
             if pos is None or pos[1] != j:
+                log.debug("restamp probe: peer %d slot mismatch "
+                          "pos=%s", j, pos)
                 continue  # peer not in this set row at its slot: skip
             row = ref.sets[pos[0]]
             save_format(disk, FormatErasure(
                 ref.deployment_id, row[i], ref.sets,
                 ref.distribution_algo))
+            log.info("restamped fresh disk %d (%s) as %s", i,
+                     getattr(disk, "root", disk), row[i][:8])
             return True
+        log.debug("restamp: no usable peer for disk %d", i)
         return False
 
     def start(self) -> None:
@@ -469,7 +502,14 @@ class NewDiskMonitor:
             try:
                 self._heal_format(i, disk)
             except Exception:
-                pass  # dead disk / no healthy peer: volumes check next
+                # Dead disk / no healthy peer reachable right now: the
+                # volumes check below still runs, and every later tick
+                # retries the re-stamp. Log it — a silently un-stamped
+                # drive would fail the NEXT restart's format quorum.
+                import logging
+                logging.getLogger("minio_tpu.heal").warning(
+                    "format re-stamp failed for disk %d (%s)",
+                    i, getattr(disk, "root", disk), exc_info=True)
             try:
                 vols = set(disk.list_volumes())
             except Exception:

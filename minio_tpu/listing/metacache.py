@@ -67,6 +67,10 @@ class MetacacheManager:
         self.peer_share = None
         self.share_id: tuple[int, int] = (0, 0)
         self.peer_serves = 0  # served-from-peer counter (tests/metrics)
+        # (bucket, root) -> OUR tracker counter at the last owner
+        # fetch; a moved counter means this node wrote since then and
+        # the next fetch must force the owner to rescan.
+        self._peer_fetch_counters: dict[tuple[str, str], int] = {}
 
     # -- scan -------------------------------------------------------------
 
@@ -165,12 +169,25 @@ class MetacacheManager:
         if share is not None:
             owner = share.owner_key(bucket, root)
             if owner is not None:
+                # Read-after-write THROUGH THIS NODE survives sharing:
+                # the owner's tracker never sees writes done via other
+                # nodes, so when OUR tracker moved since our last fetch
+                # of this root, the first page asks the owner to drop
+                # its cache and rescan (write-then-list costs one scan,
+                # exactly like the unshared design; read-mostly listing
+                # stays shared).
+                tracker = getattr(self.engine, "update_tracker", None)
+                counter = (tracker.bucket_counter(bucket) if tracker
+                           else -1)
+                key = (bucket, root)
+                force = self._peer_fetch_counters.get(key) != counter
+                self._peer_fetch_counters[key] = counter
                 return self._peer_then_local(share, owner, bucket,
-                                             root, after)
+                                             root, after, force)
         return self._entries_local(bucket, root)
 
     def _peer_then_local(self, share, owner: str, bucket: str,
-                         root: str, after: str):
+                         root: str, after: str, force: bool = False):
         """Stream the owner's entries; on ANY transport failure —
         first page or mid-stream — continue from a local scan at the
         last yielded name, so an owner crash degrades a listing to a
@@ -178,7 +195,7 @@ class MetacacheManager:
         shared-scan optimization)."""
         last = after
         it = share.fetch_entries(owner, self.share_id, bucket, root,
-                                 after=after)
+                                 after=after, force=force)
         served = False
         while True:
             try:

@@ -139,6 +139,13 @@ class PeerRPCService:
         layer = self._server().layer
         pools = _pools(layer)
         mgr = pools[int(args["pool"])].sets[int(args["set"])].metacache
+        if args.get("force"):
+            # The caller wrote through its own node since its last
+            # fetch: our tracker never saw that, so drop the cache and
+            # rescan (preserves read-after-write through any node).
+            with mgr._mu:
+                mgr._caches.pop((args["bucket"],
+                                 args.get("root", "")), None)
         entries = mgr._entries_local(args["bucket"],
                                      args.get("root", ""))
         after = args.get("after", "")
@@ -181,17 +188,22 @@ class MetacacheShare:
         return None if owner in self.my_keys else owner
 
     def fetch_entries(self, owner: str, share_id: tuple[int, int],
-                      bucket: str, root: str, after: str = ""):
+                      bucket: str, root: str, after: str = "",
+                      force: bool = False):
         """Generator streaming the owner's entries page by page,
         starting past `after`; pages stop being fetched as soon as the
         consumer stops (a list_path hitting max_keys never pulls the
-        rest of a huge listing)."""
+        rest of a huge listing). `force` makes the FIRST page drop the
+        owner's cache (writes went through the caller's node)."""
         client = self.notification.peers[owner]
+        first = True
         while True:
             res, _ = client.call("peer", "list_entries", {
                 "pool": share_id[0], "set": share_id[1],
                 "bucket": bucket, "root": root, "after": after,
+                "force": bool(force and first),
                 "limit": LIST_PAGE_ENTRIES})
+            first = False
             entries = res["entries"]
             yield from entries
             if not res.get("truncated") or not entries:

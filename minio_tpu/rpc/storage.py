@@ -158,6 +158,15 @@ class RemoteStorage(StorageAPI):
     def __init__(self, client: RPCClient, disk_path: str):
         self.client = client
         self.disk_path = disk_path
+        # Remote disks mean quorum fan-outs wait on the network: those
+        # waits must overlap even on a single-core host. This is a
+        # deliberate ONE-WAY latch for the process lifetime (see
+        # parallel/quorum.py FORCE_THREADS): a node that ever had a
+        # remote disk may still hold RPC-backed lockers/peers, and
+        # threaded fan-outs are always correct — only ~ms slower on
+        # the single-core all-local case.
+        from ..parallel import quorum
+        quorum.FORCE_THREADS = True
 
     def __repr__(self) -> str:
         return f"RemoteStorage({self.client.endpoint()}{self.disk_path})"

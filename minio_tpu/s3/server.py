@@ -881,8 +881,10 @@ class S3ApiHandlers:
 
     def put_object(self, req: S3Request) -> S3Response:
         from ..utils import compress, streams
+        from ..utils.phasetimer import PUT as _PUT
         if "x-amz-copy-source" in req.headers:
             return self.copy_object(req)
+        _t_start = time.perf_counter()
         size_hint = (req.content_length if req.body_stream is not None
                      else len(req.body))
         if size_hint > MAX_OBJECT_SIZE:
@@ -934,6 +936,9 @@ class S3ApiHandlers:
         versioned = self._versioned(req.bucket)
         replaced = self._usage_replaced_size(req.bucket, req.key,
                                              versioned)
+        _PUT.record("transform",
+                    (time.perf_counter() - _t_start) * 1e3)
+        _t_layer = time.perf_counter()
         try:
             info = self.layer.put_object(
                 req.bucket, req.key, body, metadata=meta,
@@ -949,6 +954,8 @@ class S3ApiHandlers:
             raise s3err.ERR_NOT_IMPLEMENTED
         except ParentIsObject:
             raise s3err.ERR_PARENT_IS_OBJECT
+        _t_post = time.perf_counter()
+        _PUT.record("layer_total", (_t_post - _t_layer) * 1e3)
         self._usage_add(req.bucket, info.size - replaced)
         h = {"ETag": f'"{info.etag}"'}
         h.update(self._sse_response_headers(info))
@@ -957,6 +964,7 @@ class S3ApiHandlers:
         from ..event import event as ev
         self._notify(ev.OBJECT_CREATED_PUT, req.bucket, req.key, info)
         self._queue_replication(req, info, meta)
+        _PUT.record("post", (time.perf_counter() - _t_post) * 1e3)
         return S3Response(200, headers=h)
 
     def copy_object(self, req: S3Request) -> S3Response:
@@ -2511,7 +2519,12 @@ class S3Server:
             # the credential (ref AssumeRoleWithLDAPIdentity,
             # cmd/sts-handlers.go:78-93).
             return self.sts_ldap_identity(req)
+        _t_auth = time.perf_counter()
         access_key = self.authenticate(req)
+        if req.method == "PUT" and req.key:
+            from ..utils.phasetimer import PUT as _PUT
+            _PUT.record("auth_sigv4",
+                        (time.perf_counter() - _t_auth) * 1e3)
         req.access_key = access_key  # audit/trace attribution
         m, bucket, key, p = req.method, req.bucket, req.key, req.params
         # STS API: POST / (ref cmd/sts-handlers.go).

@@ -423,6 +423,23 @@ def test_cluster_shared_metacache(cluster):
     scans = [m.scans - b for m, b in zip(mgrs, base_scans)]
     serves = [m.peer_serves - b for m, b in zip(mgrs, base_peer)]
     non_owner = 1 - owner_idx
-    assert scans[owner_idx] == 1, scans      # one real walk, owner-side
-    assert scans[non_owner] == 0, scans      # the second node walked 0
-    assert serves[non_owner] == 1, serves    # ...it streamed the owner
+    # All real walks happen owner-side (<=2: the non-owner's first
+    # fetch forces one read-after-write rescan); the non-owner node
+    # walked its disks ZERO times and streamed the owner instead.
+    assert scans[non_owner] == 0, scans
+    assert 1 <= scans[owner_idx] <= 2, scans
+    assert serves[non_owner] == 1, serves
+
+    # Steady state: further listings from BOTH nodes reuse the shared
+    # cache — no node walks again.
+    mid = [m.scans for m in mgrs]
+    assert c0.request("GET", "/shlist", query="list-type=2").status == 200
+    assert c1.request("GET", "/shlist", query="list-type=2").status == 200
+    assert [m.scans for m in mgrs] == mid
+
+    # Read-after-write THROUGH THE NON-OWNER: a write via that node
+    # must be visible in its own immediately-following listing.
+    cn = (c0, c1)[non_owner]
+    assert cn.put_object("shlist", "raw-check", b"y").status == 200
+    rn = cn.request("GET", "/shlist", query="list-type=2")
+    assert rn.status == 200 and b"raw-check" in rn.body

@@ -354,6 +354,10 @@ def test_failed_put_leaves_no_tmp_garbage(tmp_path):
     e.make_bucket("b")
     e.disks[2].fail_methods = {"rename_data"}
     e.put_object("b", "obj", os.urandom(5000))
+    # The failed commit feeds the MRF queue; its BACKGROUND heal
+    # attempt stages (and, failing the same way, cleans) tmp files —
+    # join it so the assertion can't race that in-flight cleanup.
+    e.mrf.stop()
     tmp_dir = os.path.join(e.disks[2].inner.root, ".minio.sys", "tmp")
     assert not os.path.isdir(tmp_dir) or os.listdir(tmp_dir) == []
 

@@ -261,3 +261,36 @@ def test_heal_zero_byte_and_metadata_only(engine):
     assert r.healed_disks == [5]
     got, _ = engine.get_object("b", "empty")
     assert got == b""
+
+
+def test_monitor_restamps_format_on_hot_swap(tmp_path):
+    """A hot-swapped drive gets its format.json back from a set peer —
+    deployment id preserved, slot uuid taken from the format row at
+    the disk's position (ref HealFormat re-stamping blank replacement
+    drives, cmd/erasure-sets.go)."""
+    from minio_tpu.storage.format import (FormatErasure, load_format,
+                                          save_format)
+    import uuid as uuidlib
+    e = make_engine(tmp_path, n=4, block_size=4096)
+    e.make_bucket("fb")
+    e.put_object("fb", "obj", os.urandom(9000))
+    # Give the engine's disks a real formats topology (make_engine
+    # builds raw disks without one).
+    dep = str(uuidlib.uuid4())
+    row = [str(uuidlib.uuid4()) for _ in e.disks]
+    for d, u in zip(e.disks, row):
+        save_format(d, FormatErasure(dep, u, [row]))
+
+    target = e.disks[1]
+    shutil.rmtree(target.root)
+    os.makedirs(target.root)
+    assert load_format(target) is None
+    mon = e.new_disk_monitor
+    assert mon.tick() == [1]         # swept AND re-stamped
+    fmt = load_format(target)
+    assert fmt is not None
+    assert fmt.deployment_id == dep
+    assert fmt.this == row[1]        # slot identity restored
+    assert fmt.sets == [row]
+    assert os.path.exists(os.path.join(target.root, "fb", "obj",
+                                       "xl.meta"))

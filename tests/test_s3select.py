@@ -266,3 +266,24 @@ def test_select_over_http(tmp_path):
         assert kinds[-1] == "End"
     finally:
         srv.stop()
+
+
+def test_csv_chunked_parse_quote_boundaries(monkeypatch):
+    """Chunked CSV parse (ref pkg/s3select/csv/reader.go): record
+    boundaries never split a quoted field, whatever the chunk size,
+    and the quote-free fast path agrees with the csv state machine."""
+    from minio_tpu.s3select import readers as R
+    data = (b'h1,h2,h3\n'
+            b'a,"multi\nline\nfield",c\n'
+            b'"q""uoted",plain,"x,y"\n'
+            + b"\n".join(b"r%d,s%d,t%d" % (i, i, i)
+                         for i in range(50)) + b"\n")
+    want = list(R.csv_records(data, file_header_info="USE"))
+    assert want[0] == {"h1": "a", "h2": "multi\nline\nfield",
+                      "h3": "c"}
+    assert want[1] == {"h1": 'q"uoted', "h2": "plain", "h3": "x,y"}
+    assert len(want) == 52
+    for chunk in (7, 16, 33, 100):
+        monkeypatch.setattr(R, "CSV_CHUNK_BYTES", chunk)
+        assert list(R.csv_records(data, file_header_info="USE")) == \
+            want, chunk
