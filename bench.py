@@ -462,7 +462,10 @@ class _DeviceHunt(threading.Thread):
                 self.last_error = f"device-probe: {err}"
                 if "no accelerator" in err:
                     return  # deterministic: this host has no device
-                self._stop.wait(15)
+                # Each probe subprocess costs ~10s of jax import CPU;
+                # probing too eagerly would contend with the very
+                # configs this bench is measuring on a small host.
+                self._stop.wait(45)
                 continue
             self.device_seen = True
             _progress("device up; running device bench subprocess")

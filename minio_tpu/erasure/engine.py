@@ -580,8 +580,8 @@ class ErasureObjects:
         if len(data) == 0:
             return [b""] * n
         shard_size = codec.shard_size()
-        raw_shards: list[bytearray] = [bytearray() for _ in range(n)]
 
+        full_frames = None
         nfull = len(data) // self.block_size
         if nfull:
             # Each block is zero-padded to k*shard_size (split padding
@@ -595,17 +595,31 @@ class ErasureObjects:
                 padded[:, :self.block_size] = full
                 full = padded
             full = full.reshape(nfull, k, shard_size)
-            encoded = codec.encode_blocks_batch(full)
-            for j in range(n):
-                raw_shards[j] += encoded[:, j, :].tobytes()
+            # Shard-major framing: each full block is exactly one
+            # bitrot sub-block, so (n_blocks, S) rows frame directly —
+            # no per-shard byte reassembly (this copy-count cut
+            # roughly doubled host multipart encode throughput). The
+            # pure-host path encodes straight into shard-major; the
+            # device/coalescer path returns (B, n, S) and pays one
+            # transpose copy.
+            from ..ops import batching as _b
+            if not codec._use_tpu(full.nbytes) \
+                    and not codec._coalesce_ok():
+                sm = _b.host_encode_shardmajor(full, k, m)
+            else:
+                encoded = codec.encode_blocks_batch(full)
+                sm = np.ascontiguousarray(encoded.transpose(1, 0, 2))
+            full_frames = bitrot.encode_stream_arrays(list(sm))
         rest = data[nfull * self.block_size:]
-        if rest:
-            shards = codec.encode_data(rest)
-            for j in range(n):
-                raw_shards[j] += shards[j].tobytes()
-
-        return bitrot.encode_streams([bytes(s) for s in raw_shards],
-                                     shard_size)
+        if not rest:
+            return full_frames
+        shards = codec.encode_data(rest)
+        tail_frames = bitrot.encode_streams(
+            [shards[j].tobytes() for j in range(n)], shard_size)
+        if full_frames is None:
+            return tail_frames
+        return [np.concatenate([ff, np.frombuffer(tf, np.uint8)])
+                for ff, tf in zip(full_frames, tail_frames)]
 
     def _encode_object(self, data: bytes, k: int | None = None,
                        m: int | None = None,

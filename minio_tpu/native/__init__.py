@@ -112,6 +112,27 @@ def hh256_chunks_native(data: bytes, chunk_size: int,
     return [out.raw[i * 32:(i + 1) * 32] for i in range(n)]
 
 
+def hh256_rows_native(arr, key: bytes):
+    """Hash each row of a CONTIGUOUS (n, chunk) uint8 array -> (n, 32)
+    uint8 array, with zero input copies (the array's buffer is handed
+    straight to the C kernel). None if the native lib is unavailable.
+    Byte-identical to hh256_chunks_native over arr.tobytes()."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    import numpy as np
+    if arr.size == 0:
+        return np.empty((0, 32), dtype=np.uint8)
+    a = np.ascontiguousarray(arr, dtype=np.uint8)
+    n, chunk = a.shape
+    out = np.empty((n, 32), dtype=np.uint8)
+    got = lib.hh256_chunks(
+        key, ctypes.cast(a.ctypes.data, ctypes.c_char_p), a.size,
+        chunk, ctypes.cast(out.ctypes.data, ctypes.c_char_p))
+    assert got == n
+    return out
+
+
 # Large host applies (heal sweeps, mask-group folds in degraded mode)
 # spread column ranges across threads; small ones stay single-threaded
 # so per-request latency paths and the bench baseline are unaffected.

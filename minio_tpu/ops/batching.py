@@ -276,6 +276,25 @@ def host_encode(blocks: np.ndarray, k: int, m: int) -> np.ndarray:
     return out
 
 
+def host_encode_shardmajor(blocks: np.ndarray, k: int,
+                           m: int) -> np.ndarray:
+    """(B, k, S) -> SHARD-MAJOR (k+m, B, S) contiguous, on the host.
+
+    Same bytes as host_encode transposed, but two full-batch copies
+    cheaper: the matrix apply reads the output buffer's own data rows
+    as its (k, B*S) columns view (zero-copy), and the caller's bitrot
+    framing wants shard-major anyway (engine._encode_batch)."""
+    from .rs_matrix import parity_matrix
+    B, _, S = blocks.shape
+    out = np.empty((k + m, B, S), dtype=np.uint8)
+    out[:k] = blocks.transpose(1, 0, 2)
+    parity = host_apply(parity_matrix(k, m),
+                        out[:k].reshape(k, B * S))
+    out[k:] = parity.reshape(m, B, S)
+    STATS.add(False, blocks.nbytes)
+    return out
+
+
 @dataclass
 class _EncodeRequest:
     blocks: np.ndarray  # (B, k, S) uint8 data shards

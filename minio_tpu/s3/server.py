@@ -2509,9 +2509,11 @@ class S3Server:
                     "multipart/form-data")):
             return self._post_policy(req)
         if (req.method == "POST" and not req.bucket
-                and b"AssumeRoleWithWebIdentity" in req.body):
-            # WebIdentity STS is unauthenticated: the TOKEN is the
-            # credential (ref AssumeRoleWithWebIdentity handler).
+                and (b"AssumeRoleWithWebIdentity" in req.body
+                     or b"AssumeRoleWithClientGrants" in req.body)):
+            # JWT-based STS is unauthenticated: the TOKEN is the
+            # credential (ref one shared JWT handler for WebIdentity
+            # and ClientGrants, cmd/sts-handlers.go:86,270-305).
             return self.sts_web_identity(req)
         if (req.method == "POST" and not req.bucket
                 and b"AssumeRoleWithLDAPIdentity" in req.body):
@@ -2764,13 +2766,19 @@ class S3Server:
         from ..iam.oidc import OIDCError
         form = dict(urllib.parse.parse_qsl(
             req.body.decode("utf-8", "replace")))
-        if form.get("Action") != "AssumeRoleWithWebIdentity":
+        action = form.get("Action")
+        if action not in ("AssumeRoleWithWebIdentity",
+                          "AssumeRoleWithClientGrants"):
             raise s3err.ERR_NOT_IMPLEMENTED
         validator = self._openid_validator()
         if validator is None or self.iam is None:
             raise s3err.ERR_NOT_IMPLEMENTED
+        # ClientGrants sends the provider token as `Token`; WebIdentity
+        # as `WebIdentityToken` (ref stsToken/stsWebIdentityToken,
+        # cmd/sts-handlers.go:300-303). Validation is identical.
+        token = (form.get("Token") or form.get("WebIdentityToken", ""))
         try:
-            claims = validator.validate(form.get("WebIdentityToken", ""))
+            claims = validator.validate(token)
         except OIDCError:
             raise s3err.ERR_ACCESS_DENIED
         except Exception:
@@ -2790,14 +2798,17 @@ class S3Server:
         except KeyError:
             raise s3err.ERR_ACCESS_DENIED
         ns = "https://sts.amazonaws.com/doc/2011-06-15/"
-        root = Element("AssumeRoleWithWebIdentityResponse", ns)
-        result = root.child("AssumeRoleWithWebIdentityResult")
+        grants = action == "AssumeRoleWithClientGrants"
+        root = Element(f"{action}Response", ns)
+        result = root.child("ClientGrantsResult" if grants
+                            else "AssumeRoleWithWebIdentityResult")
         c = result.child("Credentials")
         c.child("AccessKeyId", cred.access_key)
         c.child("SecretAccessKey", cred.secret_key)
         c.child("SessionToken", cred.session_token)
         c.child("Expiration", _iso8601(cred.expiration))
-        result.child("SubjectFromWebIdentityToken", subject)
+        result.child("SubjectFromToken" if grants
+                     else "SubjectFromWebIdentityToken", subject)
         return S3Response(200, root.tobytes(),
                           {"Content-Type": "application/xml"})
 

@@ -394,3 +394,36 @@ def test_sts_ldap_identity(s3_server, ldap_server):
         assert status == 403
     finally:
         srv.ldap_identity = None
+
+
+def test_sts_client_grants(s3_server, jwks_server, monkeypatch):
+    """AssumeRoleWithClientGrants: same JWT validation as WebIdentity,
+    ClientGrants wire shape (ref the shared JWT handler,
+    cmd/sts-handlers.go:86,270-305,427-432)."""
+    srv, port = s3_server
+    url, _ = jwks_server
+    monkeypatch.setenv("MINIO_IDENTITY_OPENID_JWKS_URL", url)
+    monkeypatch.delenv("MINIO_IDENTITY_OPENID_SECRET", raising=False)
+    adm = AdminClient("127.0.0.1", port, "stsroot", "stsroot-secret")
+    adm.add_policy("grantsro", {"Version": "2012-10-17", "Statement": [
+        {"Effect": "Allow", "Action": ["s3:ListAllMyBuckets"],
+         "Resource": ["arn:aws:s3:::*"]}]})
+    token = rs256_sign({"sub": "svc@provider", "policy": "grantsro",
+                        "exp": time.time() + 600})
+    status, out = _sts_post(port, {
+        "Action": "AssumeRoleWithClientGrants", "Token": token,
+        "Version": "2011-06-15"})
+    assert status == 200, out
+    doc = ET.fromstring(out)
+    assert doc.tag.endswith("AssumeRoleWithClientGrantsResponse")
+    assert doc.find(".//sts:ClientGrantsResult",
+                    namespaces=_STS_NS) is not None
+    assert doc.findtext(".//sts:SubjectFromToken",
+                        namespaces=_STS_NS) == "svc@provider"
+    ak, sk, st = _creds(out)
+    c = S3Client("127.0.0.1", port, ak, sk)
+    assert c.request("GET", "/", headers={
+        "x-amz-security-token": st}).status == 200
+    status, _ = _sts_post(port, {
+        "Action": "AssumeRoleWithClientGrants", "Token": "garbage"})
+    assert status == 403
