@@ -163,10 +163,20 @@ def encode_stream_arrays(arrs, algo: str = DEFAULT_ALGORITHM):
             for a in arrs:
                 per_shard_digs.append(digs[row:row + a.shape[0]])
                 row += a.shape[0]
+    if per_shard_digs is None:
+        # Host hashing: shards fan out on multicore (the native kernel
+        # releases the GIL), sequential where a second core doesn't
+        # exist — same policy as _host_digest_many.
+        from ..parallel.quorum import MULTICORE, parallel_map
+        if len(arrs) > 1 and MULTICORE:
+            per_shard_digs, errs = parallel_map(
+                [lambda a=a: digest_rows(algo, a) for a in arrs])
+            if any(e is not None for e in errs):
+                per_shard_digs = None
+        if per_shard_digs is None:
+            per_shard_digs = [digest_rows(algo, a) for a in arrs]
     out = []
-    for i, a in enumerate(arrs):
-        hs = (per_shard_digs[i] if per_shard_digs is not None
-              else digest_rows(algo, a))
+    for a, hs in zip(arrs, per_shard_digs):
         B, S = a.shape
         frame = np.empty((B, hsize + S), dtype=np.uint8)
         frame[:, :hsize] = hs

@@ -139,6 +139,19 @@ class Erasure:
         return batching.host_encode(blocks, self.data_blocks,
                                     self.parity_blocks)
 
+    def encode_blocks_batch_shardmajor(self, blocks: np.ndarray,
+                                       ) -> np.ndarray:
+        """Batched encode returning SHARD-MAJOR (k+m, B, S) contiguous —
+        the layout the bitrot framer wants. The pure-host path encodes
+        straight into that layout (two full-batch copies cheaper); the
+        device/coalescer path reuses encode_blocks_batch and pays one
+        transpose copy."""
+        if self._use_tpu(blocks.nbytes) or self._coalesce_ok():
+            encoded = self.encode_blocks_batch(blocks)
+            return np.ascontiguousarray(encoded.transpose(1, 0, 2))
+        return batching.host_encode_shardmajor(
+            blocks, self.data_blocks, self.parity_blocks)
+
     def decode_data_blocks(self, shards: list[np.ndarray | None],
                            ) -> list[np.ndarray]:
         """Reconstruct missing DATA shards in place of Nones

@@ -598,17 +598,8 @@ class ErasureObjects:
             # Shard-major framing: each full block is exactly one
             # bitrot sub-block, so (n_blocks, S) rows frame directly —
             # no per-shard byte reassembly (this copy-count cut
-            # roughly doubled host multipart encode throughput). The
-            # pure-host path encodes straight into shard-major; the
-            # device/coalescer path returns (B, n, S) and pays one
-            # transpose copy.
-            from ..ops import batching as _b
-            if not codec._use_tpu(full.nbytes) \
-                    and not codec._coalesce_ok():
-                sm = _b.host_encode_shardmajor(full, k, m)
-            else:
-                encoded = codec.encode_blocks_batch(full)
-                sm = np.ascontiguousarray(encoded.transpose(1, 0, 2))
+            # roughly doubled host multipart encode throughput).
+            sm = codec.encode_blocks_batch_shardmajor(full)
             full_frames = bitrot.encode_stream_arrays(list(sm))
         rest = data[nfull * self.block_size:]
         if not rest:
@@ -949,6 +940,11 @@ class ErasureObjects:
                 if j not in verified and fetch(j):
                     verify_window([j])
 
+            # (A vectorized group-gather fast path was tried here and
+            # REVERTED: numpy's strided (n_cov, k, S) assignment
+            # measured ~27% slower than the per-block tobytes+join
+            # below on the host — bytes.join over contiguous views is
+            # already near-memcpy speed.)
             gathered: list[tuple[int, int, list]] = []
             for b, blk_len, chunk in metas:
                 shards: list[np.ndarray | None] = [None] * (k + m)

@@ -115,7 +115,13 @@ class LDAPClient:
         self._sock = socket.create_connection((host, port),
                                               timeout=timeout)
         if tls:
-            ctx = tls_context or ssl._create_unverified_context()
+            ctx = tls_context
+            if ctx is None:
+                # Default matches the reference's tls_skip_verify mode;
+                # pass a real context for CA-verified directories.
+                ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
             self._sock = ctx.wrap_socket(self._sock, server_hostname=host)
         self._msg_id = 0
         self._mu = threading.Lock()

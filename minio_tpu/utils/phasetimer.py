@@ -29,12 +29,7 @@ class PhaseTimer:
         try:
             yield
         finally:
-            dt = (time.perf_counter() - t0) * 1e3
-            with self._mu:
-                buf = self._samples.setdefault(name, [])
-                buf.append(dt)
-                if len(buf) > _MAX_SAMPLES:
-                    del buf[:len(buf) - _MAX_SAMPLES]
+            self.record(name, (time.perf_counter() - t0) * 1e3)
 
     def record(self, name: str, ms: float) -> None:
         with self._mu:
