@@ -462,10 +462,10 @@ class _DeviceHunt(threading.Thread):
                 self.last_error = f"device-probe: {err}"
                 if "no accelerator" in err:
                     return  # deterministic: this host has no device
-                # Each probe subprocess costs ~10s of jax import CPU;
-                # probing too eagerly would contend with the very
-                # configs this bench is measuring on a small host.
-                self._stop.wait(45)
+                # Probes run niced (device_watch.probe), but even so:
+                # a hung relay means ~150s per attempt, so within one
+                # bench window few retries are possible anyway.
+                self._stop.wait(120)
                 continue
             self.device_seen = True
             _progress("device up; running device bench subprocess")
@@ -530,7 +530,15 @@ def main() -> None:
 
     # All five configs in host mode (device_asserted=False); the hunt
     # measures the device-backed variants concurrently in its subprocess.
-    workdir = tempfile.mkdtemp(prefix="minio-tpu-bench-")
+    # Workdir on tmpfs when available: the VM disk's writeback
+    # throttling swings single-shard writes 2-12ms run to run, drowning
+    # the codec/engine signal these configs track (labeled so the
+    # record says what was measured).
+    workdir = tempfile.mkdtemp(
+        prefix="minio-tpu-bench-",
+        dir="/dev/shm" if os.path.isdir("/dev/shm") else None)
+    out["workdir"] = ("tmpfs" if workdir.startswith("/dev/shm")
+                      else "disk")
     configs: list[dict] = []
     for name, fn in (("put_p50", lambda: bench_put_p50(np, workdir)),
                      ("encode_verify",

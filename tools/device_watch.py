@@ -108,9 +108,15 @@ def merge_result(result: dict, path: str | None = None) -> None:
 
 
 def probe(timeout: int = PROBE_TIMEOUT) -> tuple[bool, str]:
-    """Subprocess device probe; (ok, error). Never hangs the caller."""
+    """Subprocess device probe; (ok, error). Never hangs the caller.
+
+    The probe runs at nice 19: its ~10s of jax-import CPU would
+    otherwise contend with the very benchmarks the hunt thread probes
+    on behalf of (measured ~2-3x inflation of every host-mode number
+    on the 1-core bench box)."""
     try:
-        r = subprocess.run([sys.executable, "-c", PROBE_SRC],
+        r = subprocess.run(["nice", "-n", "19", sys.executable, "-c",
+                            PROBE_SRC],
                            capture_output=True, timeout=timeout,
                            text=True, cwd=_REPO)
         if r.returncode == 0:
