@@ -200,9 +200,23 @@ def test_wipe_restart_autoheal_converges(cluster):
     bodies = {f"o{i}": os.urandom(300_000) for i in range(6)}
     for k, b in bodies.items():
         _put_ok(c, "fault-wipe", k, b)
-    before = {k: len(_shard_files(cluster.disk_dirs(1), "fault-wipe", k))
-              for k in bodies}
-    assert all(n == DISKS_PER_NODE for n in before.values()), before
+    # Full redundancy EVERYWHERE before pulling drives: a put racing a
+    # just-restarted peer's health gate may legally commit at write
+    # quorum (4/6) and hand the missing shards to the writer's MRF.
+    # Wiping two disks while MRF is still catching up would cross the
+    # EC tolerance boundary (2 < k survivors = real data loss, same as
+    # the reference) — the scenario under test is drive replacement in
+    # a HEALTHY cluster (ref verify-healing.sh waits for heal too).
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        counts = {k: sum(len(_shard_files(cluster.disk_dirs(i),
+                                          "fault-wipe", k))
+                         for i in range(N_NODES)) for k in bodies}
+        if all(n == N_NODES * DISKS_PER_NODE for n in counts.values()):
+            break
+        time.sleep(0.5)
+    else:
+        pytest.fail(f"cluster never reached full redundancy: {counts}")
 
     cluster.kill9(1)
     for d in cluster.disk_dirs(1):
