@@ -234,9 +234,13 @@ def reconstruct_blocks(blocks: list[list[np.ndarray | None]], k: int,
 
     for (avail, missing, S), idxs in groups.items():
         mat, used = any_decode_matrix(k, m, avail, missing)
+        # One flat stack + reshape: the nested per-block stack built 64
+        # intermediates and copied every byte twice (~2x the assembly
+        # cost of a degraded read window).
         stack = np.stack([
-            np.stack([np.asarray(blocks[bi][j], dtype=np.uint8)
-                      for j in used]) for bi in idxs])
+            np.asarray(blocks[bi][j], dtype=np.uint8)
+            for bi in idxs for j in used]).reshape(
+                len(idxs), len(used), S)
         if use_device(stack.nbytes):
             try:
                 rebuilt = _device_reconstruct(stack, k, m, avail, missing)
