@@ -264,29 +264,19 @@ def bench_encode_verify(np, device: bool) -> dict:
     k, m = 8, 4
     S = (1024 * 1024) // k          # 1MiB stripe -> 128KiB shards
     batch = 32                       # 32 MiB of data per dispatch
-    shard_chunk = S                  # one bitrot sub-block per shard
     rng = np.random.default_rng(2)
     blocks = rng.integers(0, 256, (batch, k, S)).astype(np.uint8)
 
     def roundtrip(backend: str) -> float:
+        """The engine's real write pipeline for one batch: shard-major
+        encode + streaming-bitrot framing (what _encode_batch runs),
+        not a hand-rolled encode+digest loop."""
         codec = Erasure(k, m, block_size=1024 * 1024, backend=backend)
         t0 = time.perf_counter()
-        encoded = codec.encode_blocks_batch(blocks)
-        # Bitrot-hash every shard of every block; one batched (device-
-        # eligible) dispatch for the whole set (erasure/bitrot.py).
-        streams = [encoded[b, s].tobytes() for b in range(batch)
-                   for s in range(k + m)]
-        if backend == "cpu":
-            # Pin the hash to the host for the baseline measurement.
-            for st in streams:
-                if not bitrot.digest_chunks(bitrot.DEFAULT_ALGORITHM, st,
-                                            shard_chunk):
-                    raise RuntimeError("empty bitrot digest")
-        else:
-            hs = bitrot.digest_chunks_many(bitrot.DEFAULT_ALGORITHM,
-                                           streams, shard_chunk)
-            if len(hs) != len(streams):
-                raise RuntimeError("bitrot digest count mismatch")
+        sm = codec.encode_blocks_batch_shardmajor(blocks)
+        frames = bitrot.encode_stream_arrays(list(sm))
+        if len(frames) != k + m:
+            raise RuntimeError("bitrot frame count mismatch")
         return time.perf_counter() - t0
 
     from minio_tpu.ops import batching
