@@ -357,8 +357,12 @@ class ReplicationPool:
             if cur is None or cur[0] != limit:
                 cur = (limit, TokenBucket(limit))
                 self._limiters[arn] = cur
-            self.stats["throttled_count"] += 1
-        cur[1].throttle(nbytes)
+        # A capped-but-idle target passes without sleeping; only count
+        # a throttle when the token bucket actually stalled the worker.
+        waited = cur[1].throttle(nbytes)
+        if waited > 0:
+            with self._stats_mu:
+                self.stats["throttled_count"] += 1
 
     def _set_status(self, task: ReplicationTask, status: str) -> None:
         if task.op == "delete":

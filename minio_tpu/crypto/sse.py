@@ -23,9 +23,23 @@ import hashlib
 import os
 import struct
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ModuleNotFoundError:  # gated dep: serve plain objects without it
+    AESGCM = None
 
 from ..utils.streams import Reader as _StreamsReader
+
+
+def _require_aesgcm():
+    """SSE needs the AES-GCM primitive; without the cryptography
+    package the server still boots and serves PLAIN objects — only
+    encryption requests fail, at use time, with a clear error. Every
+    SSE path passes through seal_key/unseal_key first, so gating those
+    two covers the package."""
+    if AESGCM is None:
+        raise SSEError("SSE unavailable: the 'cryptography' package "
+                       "is not installed")
 
 # Metadata keys persisted in xl.meta (ref cmd/crypto/metadata.go —
 # X-Minio-Internal-Server-Side-Encryption-* namespace).
@@ -80,6 +94,7 @@ def seal_key(master: bytes, object_key: bytes, domain: str, bucket: str,
              obj: str) -> str:
     """Wrap the object key under a master/client key (ref
     ObjectKey.Seal, cmd/crypto/key.go:71)."""
+    _require_aesgcm()
     nonce = os.urandom(NONCE_SIZE)
     ct = AESGCM(master).encrypt(nonce, object_key,
                                 _seal_aad(domain, bucket, obj))
@@ -88,6 +103,7 @@ def seal_key(master: bytes, object_key: bytes, domain: str, bucket: str,
 
 def unseal_key(master: bytes, sealed: str, domain: str, bucket: str,
                obj: str) -> bytes:
+    _require_aesgcm()
     try:
         raw = base64.b64decode(sealed)
         return AESGCM(master).decrypt(

@@ -58,8 +58,12 @@ def digest_chunks(algo: str, data: bytes, chunk_size: int) -> list[bytes]:
     if len(data) == 0:
         return []
     if algo in (HIGHWAYHASH256, HIGHWAYHASH256S):
-        native = hh256_chunks_native(data, chunk_size, MAGIC_KEY)
+        from ..obs.kernel_stats import HH256, KERNEL, timed
+        with timed() as t:
+            native = hh256_chunks_native(data, chunk_size, MAGIC_KEY)
         if native is not None:
+            KERNEL.record(HH256, False, len(data), t.s,
+                          blocks=len(native))
             return native
     n = ceil_frac(len(data), chunk_size)
     return [digest(algo, data[i * chunk_size:(i + 1) * chunk_size])
@@ -123,10 +127,13 @@ def digest_rows(algo: str, arr):
             return np.asarray(digs, dtype=np.uint8)
     if algo in (HIGHWAYHASH256, HIGHWAYHASH256S):
         from ..native import hh256_rows_native
-        out = hh256_rows_native(arr, MAGIC_KEY)
+        from ..obs.kernel_stats import HH256, KERNEL, timed
+        with timed() as t:
+            out = hh256_rows_native(arr, MAGIC_KEY)
         if out is not None:
             from ..ops import batching
             batching.HH_STATS.add(False, arr.size)
+            KERNEL.record(HH256, False, arr.size, t.s, blocks=B)
             return out
     out = np.empty((B, hash_size(algo)), dtype=np.uint8)
     for i in range(B):

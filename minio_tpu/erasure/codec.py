@@ -117,11 +117,15 @@ class Erasure:
             return batching.get_coalescer().encode(
                 shards[None, :self.data_blocks, :],
                 self.data_blocks, self.parity_blocks)[0]
+        from ..obs.kernel_stats import KERNEL, RS_ENCODE, timed
         from ..ops.rs_matrix import parity_matrix
-        shards[self.data_blocks:] = batching.host_apply(
-            parity_matrix(self.data_blocks, self.parity_blocks),
-            shards[:self.data_blocks])
+        with timed() as t:
+            shards[self.data_blocks:] = batching.host_apply(
+                parity_matrix(self.data_blocks, self.parity_blocks),
+                shards[:self.data_blocks])
         batching.STATS.add(False, shards[:self.data_blocks].nbytes)
+        KERNEL.record(RS_ENCODE, False,
+                      shards[:self.data_blocks].nbytes, t.s, blocks=1)
         return shards
 
     def encode_blocks_batch(self, blocks: np.ndarray) -> np.ndarray:

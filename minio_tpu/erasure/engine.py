@@ -430,9 +430,20 @@ class ErasureObjects:
         alive = [True] * n
         disk_errs: list = [None] * n
 
-        def append_one(i: int, payload: bytes):
-            self.disks[i].append_file(MINIO_META_BUCKET, shard_rel,
-                                      payload)
+        def append_one(i: int, payload: bytes, parent=None):
+            if parent is None:  # untraced fast path
+                self.disks[i].append_file(MINIO_META_BUCKET, shard_rel,
+                                          payload)
+                return
+            # Explicit parent: parallel_map workers don't inherit the
+            # request thread's contextvar; entering this span seeds it
+            # so nested disk/RPC spans stitch under the right write.
+            from ..obs.span import TRACER
+            with TRACER.span("ec.shard_write", parent=parent, disk=i,
+                             endpoint=str(self.disks[i]),
+                             bytes=len(payload)):
+                self.disks[i].append_file(MINIO_META_BUCKET, shard_rel,
+                                          payload)
 
         def cleanup_tmp(indices):
             parallel_map([
@@ -440,6 +451,7 @@ class ErasureObjects:
                     MINIO_META_BUCKET, tmp_path, recursive=True)
                 for i in indices])
 
+        from ..obs.span import TRACER
         from ..utils.phasetimer import PUT as _PUT
         _t_enc = _t_wr = 0.0
         try:
@@ -452,26 +464,30 @@ class ErasureObjects:
                                               self.block_size,
                                               self.put_batch_bytes):
                 _t0 = time.perf_counter()
-                # The etag md5 overlaps the erasure encode on
-                # multicore hosts: both walk the same batch, md5
-                # releases the GIL on big buffers, and stream order is
-                # preserved because each batch joins before the next
-                # submits (~1.7ms off a 1MiB PUT's critical path).
-                md5_fut = (submit(md5.update, batch)
-                           if md5 is not None and MULTICORE else None)
-                if md5 is not None and md5_fut is None:
-                    md5.update(batch)
-                total += len(batch)
-                chunks = self._encode_batch(batch, k, m, codec)
-                if md5_fut is not None:
-                    md5_fut.result()
+                with TRACER.span("ec.encode", bytes=len(batch)):
+                    # The etag md5 overlaps the erasure encode on
+                    # multicore hosts: both walk the same batch, md5
+                    # releases the GIL on big buffers, and stream order
+                    # is preserved because each batch joins before the
+                    # next submits (~1.7ms off a 1MiB PUT's critical
+                    # path).
+                    md5_fut = (submit(md5.update, batch)
+                               if md5 is not None and MULTICORE
+                               else None)
+                    if md5 is not None and md5_fut is None:
+                        md5.update(batch)
+                    total += len(batch)
+                    chunks = self._encode_batch(batch, k, m, codec)
+                    if md5_fut is not None:
+                        md5_fut.result()
                 _t1 = time.perf_counter()
                 _t_enc += _t1 - _t0
                 live = [i for i in range(n) if alive[i]]
-                _, errs = parallel_map(
-                    [lambda i=i: append_one(
-                        i, chunks[distribution[i] - 1])
-                     for i in live])
+                with TRACER.span("ec.write", bytes=len(batch)) as _ws:
+                    _, errs = parallel_map(
+                        [lambda i=i: append_one(
+                            i, chunks[distribution[i] - 1], _ws)
+                         for i in live])
                 _t_wr += time.perf_counter() - _t1
                 for i, e in zip(live, errs):
                     if e is not None:
@@ -499,9 +515,17 @@ class ErasureObjects:
             part = ObjectPartInfo(number=1, size=total,
                                   actual_size=total, etag=etag)
 
-            def commit_one(i: int):
+            def commit_one(i: int, parent=None):
                 if not alive[i]:
                     raise disk_errs[i]
+                if parent is not None:
+                    from ..obs.span import TRACER as _TR
+                    with _TR.span("ec.shard_commit", parent=parent,
+                                  disk=i, endpoint=str(self.disks[i])):
+                        return _commit_inner(i)
+                return _commit_inner(i)
+
+            def _commit_inner(i: int):
                 fi = FileInfo(
                     volume=bucket, name=object_name,
                     version_id=version_id,
@@ -536,8 +560,10 @@ class ErasureObjects:
             # rename, not the body transfer.
             _t2 = time.perf_counter()
             with self.ns_lock.write_locked(bucket, object_name):
-                _, errs = parallel_map(
-                    [lambda i=i: commit_one(i) for i in range(n)])
+                with TRACER.span("ec.commit") as _cs:
+                    _, errs = parallel_map(
+                        [lambda i=i: commit_one(i, _cs)
+                         for i in range(n)])
                 self.guard_commit_bucket_gone(errs, bucket,
                                               object_name, version_id,
                                               wq=wq)
@@ -579,6 +605,18 @@ class ErasureObjects:
         n = k + m
         if len(data) == 0:
             return [b""] * n
+        # Kernel child span: the RS+bitrot math of this batch as seen
+        # from the request (includes any coalescer window wait); which
+        # device actually ran it is in the kernel counters
+        # (obs/kernel_stats.py).
+        from ..obs.span import TRACER
+        with TRACER.span("kernel.rs_encode", bytes=len(data),
+                         k=k, m=m):
+            return self._encode_batch_inner(data, k, m, codec)
+
+    def _encode_batch_inner(self, data: bytes, k: int, m: int,
+                            codec) -> list[bytes]:
+        n = k + m
         shard_size = codec.shard_size()
 
         full_frames = None
@@ -827,13 +865,18 @@ class ErasureObjects:
 
         want_end = offset + length
 
+        from ..obs.span import TRACER
         for g0 in range(start_block, end_block + 1, group):
             g1 = min(g0 + group - 1, end_block)
             n_cov = g1 - g0 + 1
             win_off = g0 * stride
             windows: dict[int, bytes] = {}
+            # Captured in the CONSUMER's thread each group: parallel
+            # fetch workers attach their shard-read spans to it (the
+            # contextvar doesn't cross into parallel_map threads).
+            _read_parent = TRACER.current()
 
-            def fetch(j: int) -> bool:
+            def fetch(j: int, _parent=_read_parent) -> bool:
                 """Fetch shard j's window for this group; False if
                 unavailable."""
                 if j in windows:
@@ -843,10 +886,19 @@ class ErasureObjects:
                 disk = self.disks[by_shard[j]]
                 f = agreed[by_shard[j]]
                 try:
-                    windows[j] = disk.read_file(
-                        fi.volume,
-                        f"{fi.name}/{f.data_dir}/part.{part_number}",
-                        win_off, n_cov * stride)
+                    if _parent is None:
+                        windows[j] = disk.read_file(
+                            fi.volume,
+                            f"{fi.name}/{f.data_dir}/part.{part_number}",
+                            win_off, n_cov * stride)
+                        return True
+                    with TRACER.span("ec.shard_read", parent=_parent,
+                                     shard=j, endpoint=str(disk),
+                                     bytes=n_cov * stride):
+                        windows[j] = disk.read_file(
+                            fi.volume,
+                            f"{fi.name}/{f.data_dir}/part.{part_number}",
+                            win_off, n_cov * stride)
                     return True
                 except Exception:
                     failed.add(j)

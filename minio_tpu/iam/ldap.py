@@ -95,6 +95,14 @@ class LDAPError(Exception):
     pass
 
 
+def insecure_context() -> ssl.SSLContext:
+    """No-verify TLS context for the explicit skip-verify opt-out."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE
+    return ctx
+
+
 def filter_eq(attr: str, value: str) -> bytes:
     return ber(_CTX_FILTER_EQ, ber_str(attr) + ber_str(value))
 
@@ -117,11 +125,12 @@ class LDAPClient:
         if tls:
             ctx = tls_context
             if ctx is None:
-                # Default matches the reference's tls_skip_verify mode;
-                # pass a real context for CA-verified directories.
-                ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
-                ctx.check_hostname = False
-                ctx.verify_mode = ssl.CERT_NONE
+                # VERIFYING by default: LDAPS carries the directory
+                # password, so certificate validation is the floor.
+                # Directories with private CAs opt out explicitly via
+                # MINIO_IDENTITY_LDAP_TLS_SKIP_VERIFY (insecure_context
+                # below), matching the reference's tls_skip_verify.
+                ctx = ssl.create_default_context()
             self._sock = ctx.wrap_socket(self._sock, server_hostname=host)
         self._msg_id = 0
         self._mu = threading.Lock()
@@ -251,7 +260,7 @@ class LDAPIdentity:
                  lookup_bind_password: str, user_base_dn: str,
                  user_filter: str = "(uid=%s)", group_base_dn: str = "",
                  group_filter: str = "(member=%d)", tls: bool = False,
-                 client_factory=None):
+                 tls_skip_verify: bool = False, client_factory=None):
         self.server_addr = server_addr
         self.lookup_bind_dn = lookup_bind_dn
         self.lookup_bind_password = lookup_bind_password
@@ -260,6 +269,7 @@ class LDAPIdentity:
         self.group_base_dn = group_base_dn
         self.group_filter = group_filter
         self.tls = tls
+        self.tls_skip_verify = tls_skip_verify
         self._client_factory = client_factory or self._connect
 
     @classmethod
@@ -277,12 +287,16 @@ class LDAPIdentity:
             env.get("MINIO_IDENTITY_LDAP_GROUP_SEARCH_BASE_DN", ""),
             env.get("MINIO_IDENTITY_LDAP_GROUP_SEARCH_FILTER",
                     "(member=%d)"),
-            env.get("MINIO_IDENTITY_LDAP_TLS", "") == "on")
+            env.get("MINIO_IDENTITY_LDAP_TLS", "") == "on",
+            env.get("MINIO_IDENTITY_LDAP_TLS_SKIP_VERIFY", "") == "on")
 
     def _connect(self) -> LDAPClient:
         host, _, port = self.server_addr.rpartition(":")
+        ctx = insecure_context() if (self.tls and self.tls_skip_verify) \
+            else None
         return LDAPClient(host or self.server_addr,
-                          int(port) if port else 389, tls=self.tls)
+                          int(port) if port else 389, tls=self.tls,
+                          tls_context=ctx)
 
     @staticmethod
     def _parse_filter(template: str, value: str) -> bytes:

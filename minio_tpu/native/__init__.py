@@ -87,6 +87,19 @@ def get_lib() -> ctypes.CDLL | None:
     return _LIB
 
 
+def _disable_native(reason: str) -> None:
+    """A native kernel returned inconsistent results: distrust the
+    whole library for the rest of the process (every caller degrades
+    to its host/pure-Python path) and say so loudly once."""
+    global _LIB, _TRIED
+    import logging
+    with _LOCK:
+        _LIB = None
+        _TRIED = True
+    logging.getLogger("minio_tpu.native").warning(
+        "native kernel disabled: %s", reason)
+
+
 def hh256_native(data: bytes, key: bytes) -> bytes | None:
     """One-shot HighwayHash-256 via C++; None if native lib unavailable."""
     lib = get_lib()
@@ -108,7 +121,12 @@ def hh256_chunks_native(data: bytes, chunk_size: int,
     n = -(-len(data) // chunk_size)
     out = ctypes.create_string_buffer(32 * n)
     got = lib.hh256_chunks(key, bytes(data), len(data), chunk_size, out)
-    assert got == n
+    if got != n:
+        # A short/garbled native return must NOT surface truncated
+        # digests as "valid" (a bare assert here vanishes under -O):
+        # fall back to the pure-Python path by reporting unavailable.
+        _disable_native(f"hh256_chunks returned {got}, expected {n}")
+        return None
     return [out.raw[i * 32:(i + 1) * 32] for i in range(n)]
 
 
@@ -129,7 +147,11 @@ def hh256_rows_native(arr, key: bytes):
     got = lib.hh256_chunks(
         key, ctypes.cast(a.ctypes.data, ctypes.c_char_p), a.size,
         chunk, ctypes.cast(out.ctypes.data, ctypes.c_char_p))
-    assert got == n
+    if got != n:
+        # Explicit check (not a bare assert — stripped under -O): a
+        # wrong row count means the output buffer is untrustworthy.
+        _disable_native(f"hh256_chunks returned {got}, expected {n}")
+        return None
     return out
 
 

@@ -1,0 +1,9 @@
+"""Observability: span-based request tracing (span.py), the metrics-v2
+registry with node/cluster Prometheus endpoints (metrics2.py), and TPU
+kernel accounting (kernel_stats.py). See docs/observability.md."""
+
+from .kernel_stats import KERNEL
+from .metrics2 import METRICS2
+from .span import TRACER, current_span
+
+__all__ = ["KERNEL", "METRICS2", "TRACER", "current_span"]

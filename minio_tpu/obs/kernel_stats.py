@@ -1,0 +1,87 @@
+"""TPU data-plane kernel accounting: the whole point of this
+reproduction is the TPU codec (PAPER.md), yet until metrics-v2 it
+exported zero metrics. Every kernel entry point now records through
+``KERNEL`` into the v2 registry:
+
+- ``rs_encode``  — batched Reed-Solomon encode (ops/rs_tpu.encode_batch
+  on device, ops/batching.host_encode* on the host)
+- ``rs_decode``  — mask-grouped reconstruction (ops/batching)
+- ``hh256``      — batched HighwayHash bitrot hashing (ops/hh256_tpu /
+  the host chunk path in erasure/bitrot.py)
+
+Per kernel x device the registry carries invocations, bytes, wall
+seconds, batch-occupancy blocks and coalesced request counts; the
+existing ops/batching.STATS honesty counters stay untouched (they feed
+the v1 page), metrics-v2 is the superset the next perf PR reads.
+"""
+
+from __future__ import annotations
+
+import time
+
+from .metrics2 import METRICS2
+
+RS_ENCODE = "rs_encode"
+RS_DECODE = "rs_decode"
+HH256 = "hh256"
+
+
+class KernelStats:
+    """Recording facade over the v2 registry's kernel counters."""
+
+    @staticmethod
+    def record(kernel: str, device: bool, nbytes: int,
+               wall_s: float = 0.0, blocks: int = 0,
+               requests: int = 1) -> None:
+        lbl = {"kernel": kernel, "device": "tpu" if device else "host"}
+        METRICS2.inc("minio_tpu_v2_kernel_invocations_total", lbl)
+        METRICS2.inc("minio_tpu_v2_kernel_bytes_total", lbl, nbytes)
+        if wall_s:
+            METRICS2.inc("minio_tpu_v2_kernel_wall_seconds_total", lbl,
+                         wall_s)
+        if blocks:
+            METRICS2.inc("minio_tpu_v2_kernel_batch_blocks_total", lbl,
+                         blocks)
+        if requests > 1:
+            METRICS2.inc("minio_tpu_v2_kernel_coalesced_requests_total",
+                         lbl, requests)
+
+    @staticmethod
+    def record_coalesced(kernel: str, requests: int) -> None:
+        METRICS2.inc("minio_tpu_v2_kernel_coalesced_requests_total",
+                     {"kernel": kernel, "device": "tpu"}, requests)
+
+    @staticmethod
+    def snapshot() -> dict:
+        """{kernel/device: {invocations, bytes, wall_seconds, blocks}}
+        — the admin-info / test view of the registry's kernel series."""
+        out: dict[str, dict] = {}
+        snap = METRICS2.snapshot()
+        for metric, field in (
+                ("minio_tpu_v2_kernel_invocations_total", "invocations"),
+                ("minio_tpu_v2_kernel_bytes_total", "bytes"),
+                ("minio_tpu_v2_kernel_wall_seconds_total",
+                 "wall_seconds"),
+                ("minio_tpu_v2_kernel_batch_blocks_total", "blocks")):
+            for s in snap.get(metric, {}).get("series", []):
+                lb = s["labels"]
+                key = f"{lb.get('kernel')}/{lb.get('device')}"
+                out.setdefault(key, {})[field] = s["value"]
+        return out
+
+
+KERNEL = KernelStats()
+
+
+class timed:
+    """``with timed() as t: ...; t.s`` — wall-clock for kernel calls."""
+
+    __slots__ = ("t0", "s")
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.s = time.perf_counter() - self.t0
+        return False

@@ -1,0 +1,285 @@
+"""Metrics v2: a typed, registered metric namespace with Prometheus
+histograms, node/cluster split (ref the reference's cmd/metrics-v2.go
+node vs cluster collectors).
+
+Every metric name is REGISTERED up front with its type and help text;
+recording to an unregistered name raises — tools/obs_lint.py enforces
+the same invariant statically, so the namespace cannot drift.
+
+The registry serializes to a JSON snapshot (`snapshot()`), snapshots
+from peers MERGE (`merge()` — counters add, histogram buckets add), and
+any snapshot renders to Prometheus text exposition (`render()`). The
+node endpoint renders the local snapshot; the cluster endpoint fans out
+an RPC (rpc/peer.py `metrics2`), merges, and renders the sum.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+# Latency buckets in milliseconds (requests and phases share them; the
+# +Inf bucket is implicit).
+LATENCY_BUCKETS_MS = (0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500,
+                      1000, 2500, 5000, 10000)
+
+
+class MetricsV2:
+    """Thread-safe registry of counters and histograms."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        # name -> (type, help, buckets|None)
+        self._specs: dict[str, tuple[str, str, tuple | None]] = {}
+        # name -> {labels_key: value | [bucket_counts, sum, count]}
+        self._data: dict[str, dict[tuple, object]] = {}
+        # labels_key -> labels dict (for rendering)
+        self._labels: dict[tuple, dict] = {}
+
+    # -- registration --------------------------------------------------
+
+    def register(self, name: str, mtype: str, help_text: str,
+                 buckets: tuple | None = None) -> None:
+        if mtype not in ("counter", "gauge", "histogram"):
+            raise ValueError(f"bad metric type {mtype!r}")
+        if mtype == "histogram" and buckets is None:
+            buckets = LATENCY_BUCKETS_MS
+        with self._mu:
+            self._specs[name] = (mtype, help_text, buckets)
+            self._data.setdefault(name, {})
+
+    def registered_names(self) -> set[str]:
+        with self._mu:
+            return set(self._specs)
+
+    def _key(self, labels: dict | None) -> tuple:
+        """Series identity: a sorted items tuple, NOT a serialized
+        string — this runs under the registry lock on every disk op /
+        kernel call / request, so the critical section must stay at
+        dict-key cost (the <= 5%% tracing-overhead budget)."""
+        if not labels:
+            return ()
+        key = tuple(sorted(labels.items()))
+        if key not in self._labels:
+            self._labels[key] = dict(labels)
+        return key
+
+    def _spec(self, name: str, want: tuple[str, ...]):
+        spec = self._specs.get(name)
+        if spec is None:
+            raise ValueError(f"unregistered metric {name!r} "
+                             "(register it in obs/metrics2.py)")
+        if spec[0] not in want:
+            raise ValueError(f"{name} is a {spec[0]}, not {want}")
+        return spec
+
+    # -- recording -----------------------------------------------------
+
+    def inc(self, name: str, labels: dict | None = None,
+            v: float = 1) -> None:
+        with self._mu:
+            self._spec(name, ("counter", "gauge"))
+            series = self._data[name]
+            key = self._key(labels)
+            series[key] = series.get(key, 0) + v
+
+    def set_gauge(self, name: str, labels: dict | None = None,
+                  v: float = 0) -> None:
+        with self._mu:
+            self._spec(name, ("gauge",))
+            self._data[name][self._key(labels)] = v
+
+    def observe(self, name: str, labels: dict | None = None,
+                v: float = 0.0) -> None:
+        with self._mu:
+            _, _, buckets = self._spec(name, ("histogram",))
+            series = self._data[name]
+            key = self._key(labels)
+            h = series.get(key)
+            if h is None:
+                h = series[key] = [[0] * (len(buckets) + 1), 0.0, 0]
+            counts, _, _ = h
+            for i, ub in enumerate(buckets):
+                if v <= ub:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            h[1] += v
+            h[2] += 1
+
+    def get(self, name: str, labels: dict | None = None):
+        """Current value: number (counter/gauge) or (sum, count) for a
+        histogram; 0 / (0, 0) when the series has no samples yet."""
+        with self._mu:
+            mtype = self._spec(name, ("counter", "gauge", "histogram"))[0]
+            val = self._data[name].get(self._key(labels))
+            if mtype == "histogram":
+                return (val[1], val[2]) if val else (0.0, 0)
+            return val or 0
+
+    # -- snapshot / merge / render ------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            out = {}
+            for name, (mtype, help_text, buckets) in self._specs.items():
+                series = []
+                for key, val in self._data[name].items():
+                    labels = self._labels.get(key, {})
+                    if mtype == "histogram":
+                        series.append({"labels": labels,
+                                       "counts": list(val[0]),
+                                       "sum": val[1], "count": val[2]})
+                    else:
+                        series.append({"labels": labels, "value": val})
+                out[name] = {"type": mtype, "help": help_text,
+                             "buckets": list(buckets) if buckets else None,
+                             "series": series}
+            return out
+
+    def reset(self) -> None:
+        with self._mu:
+            for name in self._data:
+                self._data[name] = {}
+
+
+def merge(*snapshots: dict) -> dict:
+    """Sum metric snapshots across nodes (counters add; histogram
+    bucket counts, sums and counts add; gauges add — cluster totals)."""
+    out: dict = {}
+    for snap in snapshots:
+        for name, m in snap.items():
+            cur = out.get(name)
+            if cur is None:
+                out[name] = {
+                    "type": m["type"], "help": m["help"],
+                    "buckets": m.get("buckets"),
+                    "series": [dict(s, labels=dict(s["labels"]),
+                                    **({"counts": list(s["counts"])}
+                                       if "counts" in s else {}))
+                               for s in m["series"]],
+                }
+                continue
+            index = {json.dumps(sorted(s["labels"].items())): s
+                     for s in cur["series"]}
+            for s in m["series"]:
+                key = json.dumps(sorted(s["labels"].items()))
+                hit = index.get(key)
+                if hit is None:
+                    add = dict(s, labels=dict(s["labels"]))
+                    if "counts" in s:
+                        add["counts"] = list(s["counts"])
+                    cur["series"].append(add)
+                    index[key] = add
+                elif "counts" in s:
+                    hit["counts"] = [a + b for a, b in
+                                     zip(hit["counts"], s["counts"])]
+                    hit["sum"] += s["sum"]
+                    hit["count"] += s["count"]
+                else:
+                    hit["value"] += s["value"]
+    return out
+
+
+def _esc(v) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+
+def _label_str(labels: dict, extra: str = "") -> str:
+    parts = [f'{k}="{_esc(v)}"' for k, v in sorted(labels.items())]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _num(v) -> str:
+    if isinstance(v, float) and v == int(v):
+        return str(int(v))
+    return repr(v) if not isinstance(v, int) else str(v)
+
+
+def render(snapshot: dict) -> str:
+    """Prometheus text exposition (format 0.0.4) of a snapshot."""
+    lines: list[str] = []
+    for name in sorted(snapshot):
+        m = snapshot[name]
+        if not m["series"]:
+            continue
+        lines.append(f"# HELP {name} {m['help']}")
+        lines.append(f"# TYPE {name} {m['type']}")
+        for s in sorted(m["series"],
+                        key=lambda s: sorted(s["labels"].items())):
+            labels = s["labels"]
+            if m["type"] == "histogram":
+                cum = 0
+                for ub, c in zip(m["buckets"], s["counts"]):
+                    cum += c
+                    le = 'le="%s"' % _num(ub)
+                    lines.append(
+                        f"{name}_bucket{_label_str(labels, le)} {cum}")
+                cum += s["counts"][-1]
+                inf = 'le="+Inf"'
+                lines.append(
+                    f"{name}_bucket{_label_str(labels, inf)} {cum}")
+                lines.append(f"{name}_sum{_label_str(labels)} "
+                             f"{_num(round(s['sum'], 6))}")
+                lines.append(f"{name}_count{_label_str(labels)} "
+                             f"{s['count']}")
+            else:
+                lines.append(f"{name}{_label_str(labels)} "
+                             f"{_num(s['value'])}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# The v2 metric namespace. EVERY name recorded anywhere in the codebase
+# must be registered here — METRICS2 raises otherwise, and
+# tools/obs_lint.py enforces it statically on the tier-1 path.
+
+METRICS2 = MetricsV2()
+
+METRICS2.register(
+    "minio_tpu_v2_api_requests_total", "counter",
+    "S3 API requests served, by api and status code.")
+METRICS2.register(
+    "minio_tpu_v2_api_request_duration_ms", "histogram",
+    "End-to-end request latency in milliseconds, by api.")
+METRICS2.register(
+    "minio_tpu_v2_api_rx_bytes_total", "counter",
+    "Request body bytes received.")
+METRICS2.register(
+    "minio_tpu_v2_api_tx_bytes_total", "counter",
+    "Response body bytes sent.")
+METRICS2.register(
+    "minio_tpu_v2_put_phase_duration_ms", "histogram",
+    "Per-phase PUT hot-path latency in milliseconds "
+    "(auth, transform, encode, write, commit, post).")
+METRICS2.register(
+    "minio_tpu_v2_disk_op_duration_ms", "histogram",
+    "Per-disk storage call latency in milliseconds, by op.")
+METRICS2.register(
+    "minio_tpu_v2_rpc_requests_total", "counter",
+    "Peer RPC calls served, by service and method.")
+METRICS2.register(
+    "minio_tpu_v2_kernel_invocations_total", "counter",
+    "Codec/hash kernel invocations, by kernel and device.")
+METRICS2.register(
+    "minio_tpu_v2_kernel_bytes_total", "counter",
+    "Bytes encoded/decoded/verified by the kernels, "
+    "by kernel and device.")
+METRICS2.register(
+    "minio_tpu_v2_kernel_wall_seconds_total", "counter",
+    "Kernel wall-clock seconds, by kernel and device.")
+METRICS2.register(
+    "minio_tpu_v2_kernel_batch_blocks_total", "counter",
+    "Blocks carried by kernel batches (occupancy numerator).")
+METRICS2.register(
+    "minio_tpu_v2_kernel_coalesced_requests_total", "counter",
+    "Requests merged into coalesced kernel dispatches.")
+METRICS2.register(
+    "minio_tpu_v2_traces_completed_total", "counter",
+    "Completed request traces.")
+METRICS2.register(
+    "minio_tpu_v2_cluster_nodes", "gauge",
+    "Nodes contributing to a cluster metrics scrape.")

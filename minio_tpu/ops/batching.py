@@ -172,8 +172,13 @@ def _device_reconstruct(stack: np.ndarray, k: int, m: int,
                         avail: tuple[int, ...], missing: tuple[int, ...],
                         ) -> np.ndarray:
     from . import rs_tpu
+    from ..obs.kernel_stats import KERNEL, RS_DECODE, timed
     bm = rs_tpu._placed_any_decode(k, m, avail, missing, serving_mesh())
-    return np.asarray(rs_tpu.gf_apply(bm, device_put_batch(stack)))
+    with timed() as t:
+        out = np.asarray(rs_tpu.gf_apply(bm, device_put_batch(stack)))
+    KERNEL.record(RS_DECODE, True, stack.nbytes, t.s,
+                  blocks=stack.shape[0])
+    return out
 
 
 def host_apply(mat: np.ndarray, cols: np.ndarray) -> np.ndarray:
@@ -193,10 +198,14 @@ def _host_reconstruct(stack: np.ndarray, mat: np.ndarray) -> np.ndarray:
     RS is byte-column-independent, so the batch dim folds into the
     columns: one (n_used, B*S) apply instead of B separate ones.
     """
+    from ..obs.kernel_stats import KERNEL, RS_DECODE, timed
     B, n_used, S = stack.shape
-    cols = stack.transpose(1, 0, 2).reshape(n_used, B * S)
-    out = host_apply(mat, cols)
-    return out.reshape(mat.shape[0], B, S).transpose(1, 0, 2)
+    with timed() as t:
+        cols = stack.transpose(1, 0, 2).reshape(n_used, B * S)
+        out = host_apply(mat, cols)
+        out = out.reshape(mat.shape[0], B, S).transpose(1, 0, 2)
+    KERNEL.record(RS_DECODE, False, stack.nbytes, t.s, blocks=B)
+    return out
 
 
 def reconstruct_blocks(blocks: list[list[np.ndarray | None]], k: int,
@@ -270,13 +279,16 @@ def host_encode(blocks: np.ndarray, k: int, m: int) -> np.ndarray:
     when built), matching the reference's per-block encode bytes
     exactly (ref cmd/erasure-coding.go:70)."""
     from .rs_matrix import parity_matrix
+    from ..obs.kernel_stats import KERNEL, RS_ENCODE, timed
     B, _, S = blocks.shape
-    out = np.zeros((B, k + m, S), dtype=np.uint8)
-    out[:, :k] = blocks
-    cols = blocks.transpose(1, 0, 2).reshape(k, B * S)
-    parity = host_apply(parity_matrix(k, m), cols)
-    out[:, k:] = parity.reshape(m, B, S).transpose(1, 0, 2)
+    with timed() as t:
+        out = np.zeros((B, k + m, S), dtype=np.uint8)
+        out[:, :k] = blocks
+        cols = blocks.transpose(1, 0, 2).reshape(k, B * S)
+        parity = host_apply(parity_matrix(k, m), cols)
+        out[:, k:] = parity.reshape(m, B, S).transpose(1, 0, 2)
     STATS.add(False, blocks.nbytes)
+    KERNEL.record(RS_ENCODE, False, blocks.nbytes, t.s, blocks=B)
     return out
 
 
@@ -289,13 +301,16 @@ def host_encode_shardmajor(blocks: np.ndarray, k: int,
     as its (k, B*S) columns view (zero-copy), and the caller's bitrot
     framing wants shard-major anyway (engine._encode_batch)."""
     from .rs_matrix import parity_matrix
+    from ..obs.kernel_stats import KERNEL, RS_ENCODE, timed
     B, _, S = blocks.shape
-    out = np.empty((k + m, B, S), dtype=np.uint8)
-    out[:k] = blocks.transpose(1, 0, 2)
-    parity = host_apply(parity_matrix(k, m),
-                        out[:k].reshape(k, B * S))
-    out[k:] = parity.reshape(m, B, S)
+    with timed() as t:
+        out = np.empty((k + m, B, S), dtype=np.uint8)
+        out[:k] = blocks.transpose(1, 0, 2)
+        parity = host_apply(parity_matrix(k, m),
+                            out[:k].reshape(k, B * S))
+        out[k:] = parity.reshape(m, B, S)
     STATS.add(False, blocks.nbytes)
+    KERNEL.record(RS_ENCODE, False, blocks.nbytes, t.s, blocks=B)
     return out
 
 
@@ -415,6 +430,12 @@ class EncodeCoalescer:
                          np.concatenate([r.blocks for r in reqs], axis=0))
                 encoded = rs_tpu.encode_batch(stack, k, m)
                 STATS.add(True, total, len(reqs))
+                if len(reqs) > 1:
+                    # rs_tpu.encode_batch counted the dispatch itself;
+                    # the coalescing win (requests merged per window)
+                    # is only visible here.
+                    from ..obs.kernel_stats import KERNEL, RS_ENCODE
+                    KERNEL.record_coalesced(RS_ENCODE, len(reqs))
                 off = 0
                 for r in reqs:
                     B = r.blocks.shape[0]

@@ -325,11 +325,14 @@ def hash_chunks(chunks: np.ndarray, key: bytes = MAGIC_KEY) -> np.ndarray:
     # Spread independent chunks across the serving mesh; the hash chain
     # is per-row, so no cross-device collectives.
     from . import batching
+    from ..obs.kernel_stats import HH256, KERNEL, timed
     m = batching.serving_mesh()
     if m is not None and B % m.size == 0:
         from ..parallel.mesh import rows_sharding
         words = jax.device_put(words, rows_sharding(m, B, 3))
         rem_packet = jax.device_put(rem_packet, rows_sharding(m, B, 2))
-    out = np.asarray(_hash_chunks_device(words, rem_packet, init,
-                                         n_full, rem))
+    with timed() as t:
+        out = np.asarray(_hash_chunks_device(words, rem_packet, init,
+                                             n_full, rem))
+    KERNEL.record(HH256, True, chunks.nbytes, t.s, blocks=B)
     return out.view(np.uint8).reshape(B, 32)

@@ -228,14 +228,20 @@ def encode_blocks(big_m: jnp.ndarray, data: jnp.ndarray) -> jnp.ndarray:
 def encode_batch(data: np.ndarray, k: int, m: int) -> np.ndarray:
     """Encode a (B, k, S) or (k, S) uint8 batch on the device(s) —
     batches spread across the serving mesh when >1 device is visible
-    (ops/batching.device_put_batch)."""
+    (ops/batching.device_put_batch). Every dispatch lands in the
+    metrics-v2 kernel counters (invocations/bytes/wall/occupancy)."""
     from . import batching
+    from ..obs.kernel_stats import KERNEL, RS_ENCODE, timed
     bm = _placed_parity(k, m, batching.serving_mesh())
-    if data.ndim == 3:
-        placed = batching.device_put_batch(data)
-    else:
-        placed = jnp.asarray(data)
-    return np.asarray(encode_blocks(bm, placed))
+    with timed() as t:
+        if data.ndim == 3:
+            placed = batching.device_put_batch(data)
+        else:
+            placed = jnp.asarray(data)
+        out = np.asarray(encode_blocks(bm, placed))
+    KERNEL.record(RS_ENCODE, True, data.nbytes, t.s,
+                  blocks=data.shape[0] if data.ndim == 3 else 1)
+    return out
 
 
 def reconstruct_batch(shards: np.ndarray, k: int, m: int,

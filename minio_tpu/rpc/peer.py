@@ -117,6 +117,13 @@ class PeerRPCService:
                  "rx_bytes": srv.metrics.rx_bytes,
                  "tx_bytes": srv.metrics.tx_bytes}, b"")
 
+    def rpc_metrics2(self, args: dict, payload: bytes):
+        """This node's metrics-v2 snapshot for cluster aggregation
+        (ref the cluster collectors of cmd/metrics-v2.go scraping
+        peers over peerRESTClient)."""
+        from ..obs.metrics2 import METRICS2
+        return ({"metrics2": METRICS2.snapshot()}, b"")
+
     def rpc_server_info(self, args: dict, payload: bytes):
         srv = self._server()
         return ({"version": __version__,
@@ -319,6 +326,13 @@ class NotificationSys:
     def metrics_all(self) -> dict:
         return {k: (v if isinstance(v, dict) else {"error": str(v)})
                 for k, v in self._fanout("metrics", {}).items()}
+
+    def metrics2_all(self) -> dict:
+        """Per-peer metrics-v2 snapshots; unreachable peers degrade to
+        an error entry (the cluster endpoint reports how many nodes
+        actually contributed)."""
+        return {k: (v if isinstance(v, dict) else {"error": str(v)})
+                for k, v in self._fanout("metrics2", {}).items()}
 
     def server_info_all(self) -> dict:
         return {k: (v if isinstance(v, dict) else {"error": str(v)})

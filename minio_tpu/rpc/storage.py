@@ -175,7 +175,18 @@ class RemoteStorage(StorageAPI):
               payload: bytes = b"") -> tuple[dict, bytes]:
         a = {"disk": self.disk_path}
         a.update(args or {})
-        return self.client.call("storage", method, a, payload)
+        from ..obs.span import TRACER, current_span
+        if current_span() is None:  # untraced fast path: no tag work
+            return self.client.call("storage", method, a, payload)
+        # Traced callers get a client-side RPC span here; the peer's
+        # server-side subtree grafts under the SAME span when the
+        # transport pops _trace_spans (rpc/transport.py), so wire time
+        # vs remote disk time separate cleanly in the stitched trace.
+        with TRACER.span(f"rpc.storage.{method}",
+                         endpoint=getattr(self.client, "endpoint",
+                                          lambda: "?")(),
+                         disk=self.disk_path):
+            return self.client.call("storage", method, a, payload)
 
     def endpoint(self) -> str:
         return f"{self.client.endpoint()}{self.disk_path}"

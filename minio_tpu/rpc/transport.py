@@ -180,6 +180,16 @@ class RPCClient:
                                 ts, args_json, payload),
             "Content-Length": str(len(body)),
         }
+        # Distributed tracing: the caller's trace context rides a tiny
+        # header; the peer opens a server-side span under it and ships
+        # its subtree back in the reserved _trace_spans result key, so
+        # a cross-node request stitches into ONE tree (the reference
+        # has no cross-node stitching — its admin trace merges flat
+        # per-node entries).
+        from ..obs.span import current_span
+        _cur = current_span()
+        if _cur is not None:
+            headers["x-mtpu-trace"] = f"{_cur.trace_id}:{_cur.span_id}"
         override = timeout is not None
         conn, reused = self._get_conn(timeout)
         while True:
@@ -199,7 +209,20 @@ class RPCClient:
                     raise wire_to_error(resp.status, rbody)
                 result_json, data = unframe(rbody)
                 self._put_conn(conn)
-                return json.loads(result_json or b"{}"), data
+                result = json.loads(result_json or b"{}")
+                if isinstance(result, dict):
+                    remote_spans = result.pop("_trace_spans", None)
+                    if remote_spans and _cur is not None and \
+                            isinstance(remote_spans, list):
+                        # Peer-supplied subtrees are untrusted input:
+                        # prune to the local depth/fan-out/size bounds
+                        # before they enter the trace ring.
+                        from ..obs.span import sanitize_remote
+                        for s in remote_spans[:8]:
+                            sc = sanitize_remote(s)
+                            if sc is not None:
+                                _cur.add_child(sc)
+                return result, data
             except (OSError, http.client.HTTPException, ValueError) as e:
                 conn.close()
                 if (reused and resp is None and isinstance(
@@ -278,7 +301,28 @@ class RPCRegistry:
             return 404, {}, f"no method {service_name}/{method}".encode()
         try:
             args = json.loads(args_json)
-            result, rbody = fn(args, payload)
+            from ..obs.metrics2 import METRICS2
+            METRICS2.inc("minio_tpu_v2_rpc_requests_total",
+                         {"service": service_name, "method": method})
+            srv_span = None
+            trace_hdr = headers.get("x-mtpu-trace", "")
+            if trace_hdr and ":" in trace_hdr:
+                # Server-side span under the caller's context; its
+                # subtree (including local disk-op children) returns in
+                # the reserved result key and grafts onto the caller's
+                # tree (RPCClient.call pops it).
+                from ..obs.span import Span
+                tid, _, pid = trace_hdr.partition(":")
+                srv_span = Span(f"rpc.server.{service_name}.{method}",
+                                tid[:64], pid[:32])
+            if srv_span is not None:
+                with srv_span:
+                    result, rbody = fn(args, payload)
+                if isinstance(result, dict):
+                    result = dict(result)
+                    result["_trace_spans"] = [srv_span.to_dict()]
+            else:
+                result, rbody = fn(args, payload)
             out = frame(json.dumps(result).encode(), rbody)
             return 200, {}, out
         except BaseException as e:  # noqa: BLE001 — serialized to peer

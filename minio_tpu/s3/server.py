@@ -2522,7 +2522,9 @@ class S3Server:
             # cmd/sts-handlers.go:78-93).
             return self.sts_ldap_identity(req)
         _t_auth = time.perf_counter()
-        access_key = self.authenticate(req)
+        from ..obs.span import TRACER
+        with TRACER.span("auth.sigv4"):
+            access_key = self.authenticate(req)
         if req.method == "PUT" and req.key:
             from ..utils.phasetimer import PUT as _PUT
             _PUT.record("auth_sigv4",
@@ -2640,6 +2642,15 @@ class S3Server:
         if raw_path == "/minio-tpu/metrics":
             text = self.metrics.prometheus(self.layer)
             return 200, "text/plain; version=0.0.4", text.encode()
+        if raw_path == "/minio-tpu/v2/metrics/node":
+            # Metrics v2, node scope: the typed registry (per-API
+            # histograms, PUT phase split, kernel counters, disk-op
+            # latency) — ref cmd/metrics-v2.go node collectors.
+            from ..obs import metrics2 as m2
+            text = m2.render(m2.METRICS2.snapshot())
+            return 200, "text/plain; version=0.0.4", text.encode()
+        if raw_path == "/minio-tpu/v2/metrics/cluster":
+            return self._metrics_cluster()
         if raw_path in ("/minio-tpu/console", "/minio-tpu/console/") \
                 and method == "GET":
             from .console import console_response
@@ -2668,24 +2679,65 @@ class S3Server:
     def publish_trace(self, api: str, method: str, path: str,
                       status: int, duration_ms: float, rx: int, tx: int,
                       request_id: str = "", remote: str = "",
-                      access_key: str = "") -> None:
+                      access_key: str = "", spans: dict | None = None,
+                      ) -> None:
         """Fan a per-request trace entry to subscribers + the audit
         sink (ref httpTraceAll wrapper, cmd/handler-utils.go:349, and
-        the AuditLog call in the same wrapper)."""
+        the AuditLog call in the same wrapper). `spans` carries the
+        request's completed span tree, so `mc admin trace` consumers
+        get the per-layer breakdown alongside the flat entry."""
         if self.trace_hub.subscriber_count:
-            self.trace_hub.publish({
+            entry = {
                 "time": time.time(), "api": api, "method": method,
                 "path": path, "statusCode": status,
                 "durationMs": round(duration_ms, 3),
                 "rx": rx, "tx": tx, "requestID": request_id,
                 "remote": remote, "accessKey": access_key,
-            })
+            }
+            if spans is not None:
+                entry["spans"] = spans
+            self.trace_hub.publish(entry)
         if self.audit is not None:
             from ..logger.audit import audit_entry
             self.audit.send(audit_entry(
                 api, method, path, status, duration_ms, rx, tx,
                 access_key=access_key, request_id=request_id,
                 remote=remote))
+
+    # One cluster scrape may fan out to every peer; cache it so an
+    # unauthenticated GET loop cannot amplify into N internal RPCs per
+    # hit (Prometheus scrapes at interval >> this TTL anyway).
+    CLUSTER_METRICS_TTL = 10.0
+    _cluster_metrics_cache: tuple[float, bytes] | None = None
+
+    def _metrics_cluster(self) -> tuple[int, str, bytes]:
+        """Metrics v2, cluster scope: this node's snapshot merged with
+        every peer's (scraped over the `metrics2` peer RPC) — the
+        node/cluster split of cmd/metrics-v2.go. Unreachable peers
+        degrade the node count, never the scrape."""
+        from ..obs import metrics2 as m2
+        cached = self._cluster_metrics_cache
+        if cached is not None and \
+                time.monotonic() - cached[0] < self.CLUSTER_METRICS_TTL:
+            return 200, "text/plain; version=0.0.4", cached[1]
+        snaps = [m2.METRICS2.snapshot()]
+        nodes = 1
+        if self.notification is not None:
+            for res in self.notification.metrics2_all().values():
+                snap = res.get("metrics2") if isinstance(res, dict) \
+                    else None
+                if snap is not None:
+                    snaps.append(snap)
+                    nodes += 1
+        merged = m2.merge(*snaps)
+        merged["minio_tpu_v2_cluster_nodes"] = {
+            "type": "gauge",
+            "help": "Nodes contributing to a cluster metrics scrape.",
+            "buckets": None,
+            "series": [{"labels": {}, "value": nodes}]}
+        body = m2.render(merged).encode()
+        self._cluster_metrics_cache = (time.monotonic(), body)
+        return 200, "text/plain; version=0.0.4", body
 
     def _cluster_healthy(self) -> bool:
         """Quorum-aware cluster check (ref ClusterCheckHandler,
@@ -2879,6 +2931,8 @@ class S3Server:
 
             def _handle(self):
                 t0 = time.monotonic()
+                root_span = None
+                finish_fn = None
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     raw_path, _, query = self.path.partition("?")
@@ -2929,6 +2983,16 @@ class S3Server:
                     if body_stream is not None:
                         req.body_stream = body_stream
                         req.content_length = length
+                    # Root span of this request's trace, keyed by the
+                    # x-amz-request-id the response already carries —
+                    # every layer below (engine, kernels, disks, peer
+                    # RPC) hangs child spans off it via the contextvar.
+                    from ..obs.span import TRACER
+                    root_span = TRACER.begin(
+                        "s3.request", req.request_id,
+                        method=self.command, path=raw_path)
+                    if root_span is not None:
+                        root_span.__enter__()
                     try:
                         resp = server.route(req)
                     except APIError as e:
@@ -2969,27 +3033,75 @@ class S3Server:
                             err.http_status,
                             err.xml(raw_path, req.request_id),
                             {"Content-Type": "application/xml"})
+                    api = (f"{self.command}-"
+                           f"{'object' if req.key else 'bucket' if req.bucket else 'service'}")
+                    body_is_stream = not isinstance(
+                        resp.body, (bytes, bytearray))
+                    trace_tree = None
+                    if root_span is not None:
+                        root_span.name = api
+                        root_span.tags["statusCode"] = resp.status
+                        if not body_is_stream or self.command == "HEAD":
+                            # Buffered response: close BEFORE further
+                            # socket work so the thread's span context
+                            # never leaks into the next keep-alive
+                            # request. STREAMING responses keep the
+                            # root open — the engine's per-group shard
+                            # reads run lazily while the body writes
+                            # below, and must still attach; the
+                            # _finish_request finally closes it.
+                            trace_tree = root_span.finish()
                     if body_stream is not None:
                         # Keep-alive hygiene: whatever the handler left
                         # unread (auth failures, early errors) must be
                         # drained before the next request parses.
                         while body_stream.read(64 * 1024):
                             pass
-                    body_is_stream = not isinstance(
-                        resp.body, (bytes, bytearray))
                     resp_len = (int(resp.headers.get("Content-Length", 0))
                                 if body_is_stream else len(resp.body))
-                    api = (f"{self.command}-"
-                           f"{'object' if req.key else 'bucket' if req.bucket else 'service'}")
-                    server.metrics.record(api, resp.status, length,
-                                          resp_len)
-                    server.bandwidth.record(req.bucket, length, resp_len)
-                    server.publish_trace(
-                        api, self.command, raw_path, resp.status,
-                        (time.monotonic() - t0) * 1000.0, length,
-                        resp_len, req.request_id,
-                        self.client_address[0],
-                        getattr(req, "access_key", ""))
+
+                    _finished = [False]
+
+                    def _finish_request():
+                        nonlocal trace_tree
+                        if _finished[0]:
+                            return
+                        _finished[0] = True
+                        if root_span is not None and trace_tree is None:
+                            trace_tree = root_span.finish()
+                        dur_ms = (time.monotonic() - t0) * 1000.0
+                        server.metrics.record(api, resp.status, length,
+                                              resp_len)
+                        from ..obs.metrics2 import METRICS2
+                        METRICS2.inc("minio_tpu_v2_api_requests_total",
+                                     {"api": api,
+                                      "status": resp.status})
+                        METRICS2.observe(
+                            "minio_tpu_v2_api_request_duration_ms",
+                            {"api": api}, dur_ms)
+                        if length:
+                            METRICS2.inc(
+                                "minio_tpu_v2_api_rx_bytes_total",
+                                None, length)
+                        if resp_len:
+                            METRICS2.inc(
+                                "minio_tpu_v2_api_tx_bytes_total",
+                                None, resp_len)
+                        server.bandwidth.record(req.bucket, length,
+                                                resp_len)
+                        server.publish_trace(
+                            api, self.command, raw_path, resp.status,
+                            dur_ms, length,
+                            resp_len, req.request_id,
+                            self.client_address[0],
+                            getattr(req, "access_key", ""),
+                            spans=trace_tree)
+
+                    finish_fn = _finish_request
+                    if not body_is_stream:
+                        # Buffered: account/publish before the write,
+                        # as before (the body cannot fail mid-flight).
+                        _finish_request()
                     self.send_response(resp.status)
                     self.send_header("x-amz-request-id", req.request_id)
                     self.send_header("Server", "MinIO-TPU")
@@ -3039,10 +3151,26 @@ class S3Server:
                             close = getattr(resp.body, "close", None)
                             if close is not None:
                                 close()
+                            # Streaming: the trace closes only now, so
+                            # it carries the lazy shard-read spans and
+                            # the duration covers the body transfer.
+                            _finish_request()
                     elif resp.body:
                         self.wfile.write(resp.body)
+                    if body_is_stream and self.command == "HEAD":
+                        _finish_request()  # stream never consumed
                 except (BrokenPipeError, ConnectionResetError):
                     pass
+                finally:
+                    # Safety nets (both idempotent): a streaming
+                    # response whose client vanished before/while the
+                    # body wrote still gets its metrics/trace
+                    # accounted, and an open span context never leaks
+                    # into the next keep-alive request on this thread.
+                    if finish_fn is not None:
+                        finish_fn()
+                    if root_span is not None:
+                        root_span.finish()
 
             def do_OPTIONS(self):
                 """CORS preflight: unauthenticated by design (ref the

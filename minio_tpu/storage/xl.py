@@ -16,12 +16,41 @@ from __future__ import annotations
 import errno
 import os
 import shutil
+import time
 import uuid
 
 from . import errors as serr
 from .interface import StorageAPI
 from .metadata import XL_META_FILE, FileInfo, XLMeta
 from ..erasure import bitrot
+from ..obs.metrics2 import METRICS2
+from ..obs.span import TRACER
+
+
+class _DiskOp:
+    """Per-disk-call instrumentation: a child span on the active trace
+    (no-op when untraced) plus the metrics-v2 disk-op histogram — the
+    per-disk attribution layer of the request trace (the reference's
+    storage layer exports xl_storage api latencies the same way in
+    cmd/metrics-v2.go)."""
+
+    __slots__ = ("op", "_cm", "_t0")
+
+    def __init__(self, op: str, root: str):
+        self.op = op
+        self._cm = TRACER.span("disk." + op, disk=root)
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        self._cm.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._cm.__exit__(*exc)
+        METRICS2.observe("minio_tpu_v2_disk_op_duration_ms",
+                         {"op": self.op},
+                         (time.perf_counter() - self._t0) * 1e3)
+        return False
 
 MINIO_META_BUCKET = ".minio.sys"
 TMP_DIR = ".minio.sys/tmp"
@@ -162,14 +191,15 @@ class XLStorage(StorageAPI):
 
     def write_all(self, volume: str, path: str, data: bytes) -> None:
         # Volume check happens in _makedirs_for, adjacent to the mkdir.
-        self._atomic_write(self._file_path(volume, path), bytes(data),
-                           volume=volume)
+        with _DiskOp("write_all", self.root):
+            self._atomic_write(self._file_path(volume, path),
+                               bytes(data), volume=volume)
 
     def read_all(self, volume: str, path: str) -> bytes:
         self._check_vol(volume)
         full = self._file_path(volume, path)
         try:
-            with open(full, "rb") as f:
+            with _DiskOp("read_all", self.root), open(full, "rb") as f:
                 return f.read()
         except FileNotFoundError:
             raise serr.FileNotFound(f"{volume}/{path}")
@@ -183,7 +213,7 @@ class XLStorage(StorageAPI):
         self._check_vol(volume)
         full = self._file_path(volume, path)
         try:
-            with open(full, "rb") as f:
+            with _DiskOp("read_file", self.root), open(full, "rb") as f:
                 f.seek(offset)
                 return f.read(length)
         except FileNotFoundError:
@@ -216,7 +246,7 @@ class XLStorage(StorageAPI):
         full = self._file_path(volume, path)
         self._makedirs_for(volume, os.path.dirname(full))
         try:
-            with open(full, "ab") as f:
+            with _DiskOp("append_file", self.root), open(full, "ab") as f:
                 f.write(data)
         except OSError as e:
             if e.errno == errno.ENOSPC:
@@ -299,6 +329,12 @@ class XLStorage(StorageAPI):
                     dst_volume: str, dst_path: str) -> None:
         """Commit: move <src>/<dataDir> under dst object dir, then merge
         fi as a version into dst xl.meta (ref cmd/xl-storage.go:1972)."""
+        with _DiskOp("rename_data", self.root):
+            self._rename_data(src_volume, src_path, fi, dst_volume,
+                              dst_path)
+
+    def _rename_data(self, src_volume: str, src_path: str, fi: FileInfo,
+                     dst_volume: str, dst_path: str) -> None:
         self._check_vol(src_volume)
         dst_obj_dir = self._file_path(dst_volume, dst_path)
         self._makedirs_for(dst_volume, dst_obj_dir)

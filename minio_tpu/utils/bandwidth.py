@@ -91,14 +91,19 @@ class TokenBucket:
                 return 0.0
             return (want - self._tokens) / self.rate
 
-    def throttle(self, nbytes: int) -> None:
+    def throttle(self, nbytes: int) -> float:
         """Block until `nbytes` may pass (chunks larger than the burst
-        are split internally so they can always eventually pass)."""
+        are split internally so they can always eventually pass).
+        Returns the seconds actually slept — 0.0 means the transfer
+        passed unthrottled, so callers can count only real stalls."""
         remaining = float(nbytes)
+        waited = 0.0
         while remaining > 0:
             want = min(remaining, self.burst)
             wait = self._take(want)
             if wait > 0:
                 time.sleep(wait)
+                waited += wait
                 continue
             remaining -= want
+        return waited

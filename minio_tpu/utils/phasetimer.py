@@ -19,9 +19,14 @@ _MAX_SAMPLES = 512  # ring per phase: recent behavior, bounded memory
 
 
 class PhaseTimer:
-    def __init__(self):
+    def __init__(self, metric: str | None = None):
+        """metric: a registered metrics-v2 histogram name — every
+        record() then ALSO lands there labeled {phase: name}, so the
+        per-phase split shows up on /minio-tpu/v2/metrics/node and in
+        cluster aggregation (obs/metrics2.py absorbs this timer)."""
         self._mu = threading.Lock()
         self._samples: dict[str, list[float]] = {}
+        self._metric = metric
 
     @contextmanager
     def phase(self, name: str):
@@ -37,6 +42,9 @@ class PhaseTimer:
             buf.append(ms)
             if len(buf) > _MAX_SAMPLES:
                 del buf[:len(buf) - _MAX_SAMPLES]
+        if self._metric is not None:
+            from ..obs.metrics2 import METRICS2
+            METRICS2.observe(self._metric, {"phase": name}, ms)
 
     def snapshot(self) -> dict[str, dict]:
         with self._mu:
@@ -56,5 +64,6 @@ class PhaseTimer:
             self._samples.clear()
 
 
-# The PUT path's shared instance (server + engine phases land here).
-PUT = PhaseTimer()
+# The PUT path's shared instance (server + engine phases land here,
+# mirrored into the metrics-v2 per-phase histogram).
+PUT = PhaseTimer(metric="minio_tpu_v2_put_phase_duration_ms")

@@ -75,10 +75,15 @@ def decompress(buf: bytes) -> bytes:
             pos += 4
         if off == 0 or off > len(out):
             raise SnappyError("copy offset out of range")
-        # Overlapping copies repeat recent output byte-by-byte.
         start = len(out) - off
-        for i in range(ln):
-            out.append(out[start + i])
+        if off >= ln:
+            # Non-overlapping: the whole source range already exists —
+            # one slice copy instead of ln appends.
+            out += out[start:start + ln]
+        else:
+            # Overlapping copies repeat recent output byte-by-byte.
+            for i in range(ln):
+                out.append(out[start + i])
     if len(out) != want:
         raise SnappyError(
             f"length mismatch: header {want}, decoded {len(out)}")

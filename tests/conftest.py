@@ -19,3 +19,15 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+# Optional-dep gate: SSE/TLS tests run only where the cryptography
+# package exists (the server itself boots without it and serves plain
+# objects — crypto/sse.py gates the import).
+import importlib.util  # noqa: E402
+
+import pytest  # noqa: E402
+
+needs_crypto = pytest.mark.skipif(
+    importlib.util.find_spec("cryptography") is None,
+    reason="needs the optional cryptography package")

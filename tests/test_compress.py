@@ -7,6 +7,8 @@ import random
 
 import pytest
 
+from conftest import needs_crypto
+
 from minio_tpu.erasure.engine import ErasureObjects
 from minio_tpu.native import lzb_compress_native, lzb_decompress_native
 from minio_tpu.s3.client import S3Client
@@ -162,6 +164,7 @@ def test_incompressible_object_stored_raw(server, client):
     assert client.get_object("zraw", "img.jpg").body == data
 
 
+@needs_crypto
 def test_compress_plus_sse_stacking(server, client):
     import base64
     import hashlib

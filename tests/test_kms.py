@@ -10,6 +10,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import pytest
 
+pytest.importorskip("cryptography",
+                    reason="SSE/TLS need the optional cryptography package")
+
 from minio_tpu.crypto.kms import KESClient, KMSError
 from minio_tpu.erasure.engine import ErasureObjects
 from minio_tpu.s3.client import S3Client

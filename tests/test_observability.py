@@ -8,6 +8,8 @@ import time
 
 import pytest
 
+from conftest import needs_crypto
+
 from minio_tpu.erasure.engine import ErasureObjects
 from minio_tpu.logger import Logger
 from minio_tpu.logger.audit import AuditWebhook, audit_entry
@@ -140,3 +142,428 @@ def test_audit_entry_shape():
                     request_id="RID")
     assert e["api"]["timeToResponseNs"] == 12_500_000
     assert e["api"]["rx"] == 0 and e["api"]["tx"] == 100
+
+
+# ---------------------------------------------------------------------------
+# Metrics v2 + span tracing (obs/): span tree assembly, RPC trace
+# propagation, kernel counters, Prometheus endpoints, and the obs lint.
+# Engine-level fixtures on purpose: they exercise the same spans the S3
+# handler threads through, without needing optional crypto deps.
+
+import http.client
+import os
+import re
+
+from minio_tpu.erasure.engine import ErasureObjects as _EO
+from minio_tpu.obs import metrics2 as m2
+from minio_tpu.obs.kernel_stats import KERNEL
+from minio_tpu.obs.span import MAX_CHILDREN, TRACER, Span
+
+
+def _walk(node, depth=0, out=None):
+    out = [] if out is None else out
+    out.append((depth, node["name"], node.get("traceId")))
+    for c in node.get("children", []):
+        _walk(c, depth + 1, out)
+    return out
+
+
+def _engine(tmp_path, n=4):
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(n)]
+    return _EO(disks, block_size=16 * 1024)
+
+
+def _traced(fn, trace_id):
+    root = TRACER.begin("test.op", trace_id)
+    root.__enter__()
+    fn()
+    return root.finish()
+
+
+def test_span_tree_covers_put_layers(tmp_path):
+    eng = _engine(tmp_path / "sp")
+    eng.make_bucket("b")
+    tree = _traced(lambda: eng.put_object("b", "k", b"x" * 100_000),
+                   "TRACEPUT")
+    names = [n for _, n, _ in _walk(tree)]
+    # Handler-root -> encode (with kernel child) -> per-disk writes ->
+    # per-disk commits, all under ONE trace id.
+    assert "ec.encode" in names
+    assert "kernel.rs_encode" in names
+    assert names.count("ec.shard_write") == 4
+    assert names.count("ec.shard_commit") == 4
+    assert all(t == "TRACEPUT" for _, _, t in _walk(tree))
+    # Child durations are real measurements that fit inside the root.
+    top = tree["children"]
+    assert all(c["durationMs"] >= 0 for c in top)
+    assert sum(c["durationMs"] for c in top) <= tree["durationMs"] * 1.1
+
+
+def test_span_tree_get_reads(tmp_path):
+    eng = _engine(tmp_path / "sg")
+    eng.make_bucket("b")
+    eng.put_object("b", "k", b"y" * 100_000)
+    tree = _traced(lambda: eng.get_object("b", "k"), "TRACEGET")
+    names = [n for _, n, _ in _walk(tree)]
+    assert "ec.shard_read" in names
+    assert "disk.read_file" in names
+
+
+def test_span_tree_concurrent_put_get(tmp_path):
+    """Concurrent requests must produce DISJOINT trees: every span in
+    a request's tree carries that request's trace id only."""
+    eng = _engine(tmp_path / "sc")
+    eng.make_bucket("b")
+    eng.put_object("b", "seed", b"s" * 50_000)
+    trees = {}
+
+    def worker(i):
+        tid = f"CONC{i}"
+        if i % 2 == 0:
+            trees[tid] = _traced(
+                lambda: eng.put_object("b", f"k{i}", b"z" * 60_000), tid)
+        else:
+            trees[tid] = _traced(
+                lambda: eng.get_object("b", "seed"), tid)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(trees) == 8
+    for tid, tree in trees.items():
+        spans = _walk(tree)
+        assert all(t == tid for _, _, t in spans), (tid, spans)
+        names = [n for _, n, _ in spans]
+        if int(tid[4:]) % 2 == 0:
+            assert "ec.encode" in names
+
+
+def test_trace_propagation_two_node_rpc(tmp_path):
+    """A PUT through an engine with remote disks yields ONE stitched
+    tree: the peer's server-side spans (with their local disk children)
+    graft under the caller's rpc.storage.* spans, same trace id
+    everywhere."""
+    from minio_tpu.rpc.cluster import derive_cluster_key
+    from minio_tpu.rpc.storage import RemoteStorage, StorageRPCService
+    from minio_tpu.rpc.transport import RPCClient, RPCRegistry
+
+    key = derive_cluster_key(ACCESS, SECRET)
+    reg1 = RPCRegistry(key)
+    remote = {str(tmp_path / "n1" / f"d{i}"):
+              XLStorage(str(tmp_path / "n1" / f"d{i}"))
+              for i in range(2)}
+    reg1.register("storage", StorageRPCService(remote))
+    srv1 = S3Server(None, ACCESS, SECRET, rpc_registry=reg1)
+    port1 = srv1.start()
+    try:
+        client = RPCClient("127.0.0.1", port1, key)
+        disks = [XLStorage(str(tmp_path / "n0" / f"d{i}"))
+                 for i in range(2)]
+        disks += [RemoteStorage(client, p) for p in remote]
+        eng = _EO(disks, block_size=16 * 1024)
+        eng.make_bucket("b")
+        tree = _traced(
+            lambda: eng.put_object("b", "k", b"w" * 80_000), "DIST1")
+        spans = _walk(tree)
+        assert all(t == "DIST1" for _, _, t in spans)
+        names = [n for _, n, _ in spans]
+        # Client-side RPC spans for the remote shard writes...
+        assert "rpc.storage.append_file" in names
+        # ...with the peer's server-side subtree grafted under them...
+        assert "rpc.server.storage.append_file" in names
+        assert "rpc.server.storage.rename_data" in names
+        # ...down to the remote node's actual disk work.
+        srv_append = [i for i, (_, n, _) in enumerate(spans)
+                      if n == "rpc.server.storage.append_file"]
+        assert srv_append, spans
+        d0, _, _ = spans[srv_append[0]]
+        assert (d0 + 1, "disk.append_file", "DIST1") in spans
+        # Local shard writes appear too (2 local + 2 remote disks).
+        assert names.count("ec.shard_write") == 4
+    finally:
+        srv1.stop()
+
+
+def test_kernel_counters_monotonic():
+    """Kernel counters only ever increase, and host RS encode/decode
+    activity lands under kernel=rs_encode/rs_decode, device=host."""
+    import numpy as np
+
+    from minio_tpu.ops import batching
+
+    lbl_enc = {"kernel": "rs_encode", "device": "host"}
+    before_inv = m2.METRICS2.get(
+        "minio_tpu_v2_kernel_invocations_total", lbl_enc)
+    before_bytes = m2.METRICS2.get(
+        "minio_tpu_v2_kernel_bytes_total", lbl_enc)
+    blocks = np.random.default_rng(0).integers(
+        0, 256, (4, 2, 512), dtype=np.uint8)
+    encoded = batching.host_encode(blocks, 2, 2)
+    mid_inv = m2.METRICS2.get(
+        "minio_tpu_v2_kernel_invocations_total", lbl_enc)
+    assert mid_inv == before_inv + 1
+    assert m2.METRICS2.get("minio_tpu_v2_kernel_bytes_total",
+                           lbl_enc) == before_bytes + blocks.nbytes
+    # Reconstruction with a lost shard counts rs_decode.
+    lbl_dec = {"kernel": "rs_decode", "device": "host"}
+    before_dec = m2.METRICS2.get(
+        "minio_tpu_v2_kernel_invocations_total", lbl_dec)
+    damaged = [[None] + [encoded[b, j] for j in range(1, 4)]
+               for b in range(4)]
+    out = batching.reconstruct_blocks(damaged, 2, 2, want_all=False,
+                                      use_device=lambda n: False)
+    assert all(o[0] is not None for o in out)
+    after_dec = m2.METRICS2.get(
+        "minio_tpu_v2_kernel_invocations_total", lbl_dec)
+    assert after_dec == before_dec + 1
+    # Monotonic: re-reading never goes down.
+    assert m2.METRICS2.get(
+        "minio_tpu_v2_kernel_invocations_total", lbl_enc) >= mid_inv
+    snap = KERNEL.snapshot()
+    assert snap["rs_encode/host"]["invocations"] >= 1
+    assert snap["rs_encode/host"]["wall_seconds"] > 0
+
+
+def test_metrics2_rejects_unregistered_names():
+    with pytest.raises(ValueError):
+        m2.METRICS2.inc("minio_tpu_v2_not_a_metric_total")
+    with pytest.raises(ValueError):
+        m2.METRICS2.observe("minio_tpu_v2_also_not_real", None, 1.0)
+
+
+def test_metrics2_merge_sums_nodes():
+    a = m2.MetricsV2()
+    b = m2.MetricsV2()
+    for r in (a, b):
+        r.register("minio_tpu_v2_api_requests_total", "counter", "x")
+        r.register("minio_tpu_v2_api_request_duration_ms", "histogram",
+                   "y", buckets=(1, 10))
+    a.inc("minio_tpu_v2_api_requests_total", {"api": "PUT"}, 3)
+    b.inc("minio_tpu_v2_api_requests_total", {"api": "PUT"}, 4)
+    b.inc("minio_tpu_v2_api_requests_total", {"api": "GET"}, 1)
+    a.observe("minio_tpu_v2_api_request_duration_ms", {"api": "PUT"},
+              0.5)
+    b.observe("minio_tpu_v2_api_request_duration_ms", {"api": "PUT"},
+              5.0)
+    merged = m2.merge(a.snapshot(), b.snapshot())
+    series = {tuple(sorted(s["labels"].items())): s
+              for s in merged["minio_tpu_v2_api_requests_total"]
+              ["series"]}
+    assert series[(("api", "PUT"),)]["value"] == 7
+    assert series[(("api", "GET"),)]["value"] == 1
+    hist = merged["minio_tpu_v2_api_request_duration_ms"]["series"][0]
+    assert hist["count"] == 2
+    assert hist["counts"] == [1, 1, 0]
+
+
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9]+(\.[0-9]+)?"
+    r"([eE][+-][0-9]+)?$")
+
+
+def _check_prometheus(text: str) -> None:
+    """Structural validity of a text exposition: TYPE'd families,
+    well-formed samples, cumulative histogram buckets capped by
+    _count."""
+    typed: dict[str, str] = {}
+    hist_cum: dict[str, int] = {}
+    for line in text.strip().split("\n"):
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, mtype = line.split(" ", 3)
+            assert mtype in ("counter", "gauge", "histogram"), line
+            typed[name] = mtype
+            continue
+        assert _SAMPLE_RE.match(line), f"bad sample line: {line!r}"
+        name = re.split(r"[{ ]", line, 1)[0]
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if base.endswith(suffix) and base[:-len(suffix)] in typed:
+                base = base[:-len(suffix)]
+        assert base in typed, f"sample without TYPE: {line!r}"
+        if name.endswith("_bucket"):
+            series = line.split(" ")[0]
+            val = int(float(line.rsplit(" ", 1)[1]))
+            key = re.sub(r'le="[^"]*",?', "", series)
+            assert val >= hist_cum.get(key, 0), \
+                f"non-cumulative bucket: {line!r}"
+            hist_cum[key] = val
+
+
+def _http_get(port: int, path: str) -> tuple[int, str, bytes]:
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", path)
+    r = conn.getresponse()
+    body = r.read()
+    ctype = r.getheader("Content-Type", "")
+    conn.close()
+    return r.status, ctype, body
+
+
+def test_v2_node_metrics_endpoint(tmp_path):
+    # Populate a few series through the real recording paths.
+    eng = _engine(tmp_path / "vm")
+    eng.make_bucket("b")
+    eng.put_object("b", "k", b"m" * 50_000)
+    srv = S3Server(None, ACCESS, SECRET)
+    port = srv.start()
+    try:
+        status, ctype, body = _http_get(port,
+                                        "/minio-tpu/v2/metrics/node")
+        assert status == 200
+        assert ctype.startswith("text/plain")
+        text = body.decode()
+        _check_prometheus(text)
+        assert "minio_tpu_v2_disk_op_duration_ms_bucket" in text
+        assert "minio_tpu_v2_kernel_invocations_total" in text
+        assert "minio_tpu_v2_put_phase_duration_ms_bucket" in text
+    finally:
+        srv.stop()
+
+
+def test_v2_cluster_metrics_endpoint_two_nodes(tmp_path):
+    """The cluster endpoint scrapes peers over the metrics2 RPC and
+    returns merged counters in valid Prometheus text."""
+    from minio_tpu.rpc.cluster import derive_cluster_key
+    from minio_tpu.rpc.peer import NotificationSys, PeerRPCService
+    from minio_tpu.rpc.transport import RPCClient, RPCRegistry
+
+    key = derive_cluster_key(ACCESS, SECRET)
+    reg1 = RPCRegistry(key)
+    reg1.register("peer", PeerRPCService("topo"))
+    srv1 = S3Server(None, ACCESS, SECRET, rpc_registry=reg1)
+    port1 = srv1.start()
+    srv0 = S3Server(None, ACCESS, SECRET)
+    srv0.notification = NotificationSys(
+        {f"127.0.0.1:{port1}": RPCClient("127.0.0.1", port1, key)})
+    port0 = srv0.start()
+    try:
+        m2.METRICS2.inc("minio_tpu_v2_api_requests_total",
+                        {"api": "PUT-object", "status": 200})
+        status, _, body = _http_get(port0,
+                                    "/minio-tpu/v2/metrics/cluster")
+        assert status == 200
+        text = body.decode()
+        _check_prometheus(text)
+        assert "minio_tpu_v2_cluster_nodes 2" in text
+        # Merged counters are present and at least the local value
+        # (both in-process nodes share the registry, so the cluster
+        # view sums to >= the node view).
+        node_text = _http_get(port0,
+                              "/minio-tpu/v2/metrics/node")[2].decode()
+
+        def val(txt):
+            for line in txt.split("\n"):
+                if line.startswith(
+                        "minio_tpu_v2_api_requests_total") and \
+                        'api="PUT-object"' in line:
+                    return float(line.rsplit(" ", 1)[1])
+            return 0.0
+
+        assert val(text) >= val(node_text) > 0
+    finally:
+        srv0.stop()
+        srv1.stop()
+
+
+def test_trace_ring_and_children_bounded():
+    TRACER.reset()
+    for i in range(TRACER.RING_SIZE + 50):
+        root = TRACER.begin("ring.test", f"R{i}")
+        root.__enter__()
+        root.finish()
+    assert len(TRACER.recent(10_000)) == TRACER.RING_SIZE
+    # Child cap: a pathological span fan-out drops the tail, counted.
+    root = TRACER.begin("cap.test", "CAP")
+    root.__enter__()
+    for _ in range(MAX_CHILDREN + 25):
+        with TRACER.span("child"):
+            pass
+    tree = root.finish()
+    assert len(tree["children"]) == MAX_CHILDREN
+    assert tree["droppedChildren"] == 25
+
+
+def test_span_noop_without_active_trace():
+    """No active trace -> span() returns the shared no-op (the <=5%%
+    overhead path) and records nothing."""
+    assert TRACER.current() is None
+    cm = TRACER.span("anything", bytes=123)
+    with cm as s:
+        assert s is None
+
+
+def test_rpc_trace_header_ignored_when_absent(tmp_path):
+    """Untraced RPC calls carry no trace header and the server adds no
+    _trace_spans key (zero overhead off the traced path)."""
+    from minio_tpu.rpc.cluster import derive_cluster_key
+    from minio_tpu.rpc.transport import RPCRegistry, frame, sign
+    import time as _time
+
+    key = derive_cluster_key(ACCESS, SECRET)
+    reg = RPCRegistry(key)
+
+    class Echo:
+        def rpc_ping(self, args, payload):
+            return {"pong": True}, b""
+
+    reg.register("echo", Echo())
+    args_json = "{}"
+    ts = str(int(_time.time()))
+    status, _, body = reg.handle(
+        "/minio-tpu/rpc/v1/echo/ping",
+        {"x-mtpu-ts": ts,
+         "x-mtpu-auth": sign(key, "echo/ping", ts, args_json, b"")},
+        frame(args_json.encode(), b""))
+    assert status == 200
+    result = json.loads(body[4:4 + int.from_bytes(body[:4], "big")])
+    assert result == {"pong": True}
+
+
+def test_obs_lint_clean():
+    """The tier-1 lint gate: no bare asserts in native/, no
+    unregistered metrics-v2 names anywhere in the package."""
+    import tools.obs_lint as lint
+    assert lint.main() == 0
+
+
+def test_phasetimer_feeds_metrics2():
+    from minio_tpu.utils.phasetimer import PUT
+    before = m2.METRICS2.get("minio_tpu_v2_put_phase_duration_ms",
+                             {"phase": "obs_test_phase"})
+    PUT.record("obs_test_phase", 2.5)
+    after = m2.METRICS2.get("minio_tpu_v2_put_phase_duration_ms",
+                            {"phase": "obs_test_phase"})
+    assert after == (before[0] + 2.5, before[1] + 1)
+
+
+@needs_crypto
+def test_s3_trace_entry_carries_spans(server, client):
+    """Full-stack: an S3 PUT published to the trace hub carries the
+    span tree alongside the flat entry (needs the full handler stack)."""
+    client.make_bucket("spanb")
+
+    def later():
+        time.sleep(0.3)
+        client.put_object("spanb", "s.txt", b"span-traced")
+
+    t = threading.Thread(target=later)
+    t.start()
+    r = client.request("GET", "/minio-tpu/admin/v1/trace",
+                       query="timeout=2")
+    t.join()
+    entries = json.loads(r.body)["entries"]
+    e = next(e for e in entries if e["api"] == "PUT-object"
+             and e["path"] == "/spanb/s.txt")
+    spans = e["spans"]
+    assert spans["traceId"] == e["requestID"]
+    names = [n for _, n, _ in _walk(spans)]
+    assert "auth.sigv4" in names
+    assert "ec.encode" in names
+    assert "kernel.rs_encode" in names
+    assert names.count("ec.shard_write") == 4
+    assert spans["tags"]["statusCode"] == 200

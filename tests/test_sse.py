@@ -8,6 +8,9 @@ import os
 
 import pytest
 
+pytest.importorskip("cryptography",
+                    reason="SSE/TLS need the optional cryptography package")
+
 from minio_tpu.crypto import sse
 from minio_tpu.erasure.engine import ErasureObjects
 from minio_tpu.s3.client import S3Client

@@ -8,6 +8,8 @@ import tracemalloc
 
 import pytest
 
+from conftest import needs_crypto
+
 from minio_tpu.erasure.engine import ErasureObjects
 from minio_tpu.storage.xl import XLStorage
 from minio_tpu.utils import streams
@@ -374,6 +376,7 @@ def _handler_get_stream(srv, bucket, key, headers=None):
     return resp, h.hexdigest(), n
 
 
+@needs_crypto
 def test_server_streaming_sse_c_memory(s3_server):
     """64MiB SSE-C PUT + GET through the handler pipeline must stay
     O(batch): the transform chain streams, never holding the object
@@ -447,6 +450,7 @@ def test_server_streaming_compression_memory(s3_server, monkeypatch):
     assert g.status == 206 and g.body == b"A" * 1_000_000
 
 
+@needs_crypto
 def test_server_streaming_sse_plus_compression(s3_server, monkeypatch):
     """Both transforms chained: stored = SSE(compress(plain)); GET
     streams decrypt -> decompress; bytes roundtrip exactly."""
@@ -470,6 +474,7 @@ def test_server_streaming_sse_plus_compression(s3_server, monkeypatch):
         in (400, 403)
 
 
+@needs_crypto
 def test_transformed_streaming_put_verifies_length(s3_server):
     """A truncated SSE streaming PUT must abort, not commit — the
     transform chain must preserve the inner HashingReader's verify()

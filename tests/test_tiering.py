@@ -7,6 +7,8 @@ import time
 
 import pytest
 
+from conftest import needs_crypto
+
 from minio_tpu.bucket import tiering
 from minio_tpu.bucket.lifecycle import TRANSITION, Lifecycle
 from minio_tpu.erasure.engine import ErasureObjects
@@ -218,6 +220,7 @@ def test_lifecycle_transition_parse():
     assert action == "none"
 
 
+@needs_crypto
 def test_sse_and_compression_survive_transition(stack, monkeypatch):
     """Transitioned bytes are the STORED envelope: SSE-S3 + compression
     still decrypt/decompress on read-through."""

@@ -9,6 +9,9 @@ import time
 
 import pytest
 
+pytest.importorskip("cryptography",
+                    reason="SSE/TLS need the optional cryptography package")
+
 from minio_tpu.utils.certs import CertManager, client_context
 
 

@@ -8,6 +8,8 @@ import urllib.parse
 
 import pytest
 
+from conftest import needs_crypto
+
 from minio_tpu.erasure.engine import ErasureObjects
 from minio_tpu.s3.client import S3Client
 from minio_tpu.s3.server import S3Server
@@ -165,6 +167,7 @@ def test_remove_object_versioned_writes_marker(server, token):
     assert data == b"precious"  # data version retained
 
 
+@needs_crypto
 def test_web_download_decrypts_and_decompresses(server, token):
     """Web download reuses the S3 read tail: SSE-S3 objects come back
     as plaintext, not stored ciphertext (ADVICE r1)."""

@@ -1,0 +1,105 @@
+"""Fast observability lint, wired into the tier-1 path
+(tests/test_observability.py runs main() and fails on any violation).
+
+Two invariants, both cheap AST walks:
+
+1. No bare ``assert`` used for error handling in ``minio_tpu/native/``:
+   a ``python -O`` run strips asserts, which would let a garbled native
+   kernel return flow onward as valid data (the hh256 row-count check
+   regressed exactly this way once — now an explicit branch).
+
+2. No unregistered metrics-v2 names: every ``minio_tpu_v2_*`` string
+   literal in the package must be registered in
+   ``minio_tpu/obs/metrics2.py`` — the namespace the node AND cluster
+   endpoints render must not drift (the registry also raises at
+   runtime; this catches dead/typoed names before they ever record).
+
+Run standalone: ``python -m tools.obs_lint``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "minio_tpu")
+METRIC_PREFIX = "minio_tpu_v2_"
+
+
+def _py_files(root: str):
+    for dirpath, _dirs, files in os.walk(root):
+        for f in files:
+            if f.endswith(".py"):
+                yield os.path.join(dirpath, f)
+
+
+def check_native_asserts() -> list[str]:
+    """Bare asserts in minio_tpu/native/ are error handling by
+    construction (the package has no test helpers) — flag them all."""
+    violations = []
+    native = os.path.join(PKG, "native")
+    for path in _py_files(native):
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assert):
+                rel = os.path.relpath(path, REPO)
+                violations.append(
+                    f"{rel}:{node.lineno}: bare assert used for error "
+                    "handling (stripped under -O); use an explicit "
+                    "check with a host-path fallback")
+    return violations
+
+
+def check_metric_names() -> list[str]:
+    """Every minio_tpu_v2_* string literal in the package must name a
+    registered metric (its base name, for _bucket/_sum/_count/label
+    suffixes rendered by the registry itself)."""
+    from minio_tpu.obs.metrics2 import METRICS2
+    registered = METRICS2.registered_names()
+    registry_file = os.path.join(PKG, "obs", "metrics2.py")
+    violations = []
+    for path in _py_files(PKG):
+        if os.path.abspath(path) == os.path.abspath(registry_file):
+            continue
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and node.value.startswith(METRIC_PREFIX)):
+                continue
+            name = node.value
+            if name in registered:
+                continue
+            # Allow rendered-suffix forms if some caller builds them.
+            base = name
+            for suffix in ("_bucket", "_sum", "_count"):
+                if base.endswith(suffix):
+                    base = base[: -len(suffix)]
+            if base in registered:
+                continue
+            rel = os.path.relpath(path, REPO)
+            violations.append(
+                f"{rel}:{node.lineno}: unregistered metrics-v2 name "
+                f"{name!r} — register it in minio_tpu/obs/metrics2.py")
+    return violations
+
+
+def main() -> int:
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    violations = check_native_asserts() + check_metric_names()
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"obs_lint: {len(violations)} violation(s)")
+        return 1
+    print("obs_lint: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
