@@ -20,6 +20,10 @@ encode+decode GiB/s/chip (8+4, 1MiB blocks) — plus:
                                   actually ran via batching.STATS
      5. ec16+4_heal_GiBs          full-disk heal through the engine
                                   (batched reconstruct); STATS-asserted
+     6. qos_brownout              loadgen at ~4x the write cap: shed
+                                  rate + admitted p50/p99, and fg PUT
+                                  p50 with/without a concurrent heal
+                                  sweep (priority-lane interference)
   "stats":    batching.STATS snapshot (device-vs-host honesty counters)
   "errors":   per-config error strings (configs that failed still leave
               the others reported; the script never exits nonzero)
@@ -420,6 +424,115 @@ def bench_heal(np, workdir: str, device: bool = False) -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+# --- config 6: QoS brownout — overload shedding + heal interference ----------
+
+
+def bench_qos_brownout(np, workdir: str) -> dict:
+    """Two degradation numbers the QoS subsystem owns:
+
+    1. brownout: loadgen drives 1MiB PUTs at ~4x the configured write
+       cap; the server must SHED the excess with 503 SlowDown +
+       Retry-After (bounded admitted p50/p99) instead of queueing
+       unboundedly.
+    2. heal interference: foreground 1MiB PUT p50 with a continuous
+       heal sweep running vs heal-off baseline — the priority lanes
+       (qos/scheduler.py) keep repair work out of the serving path.
+    """
+    import statistics as stats
+
+    from minio_tpu.erasure.engine import ErasureObjects
+    from minio_tpu.s3.client import S3Client
+    from minio_tpu.s3.server import S3Server
+    from minio_tpu.storage.xl import XLStorage
+    from tools.loadgen import run_load
+
+    access, secret = "benchadmin", "benchadmin-secret"
+    root = os.path.join(workdir, "cfg6")
+    disks = [XLStorage(os.path.join(root, f"disk{i}")) for i in range(6)]
+    layer = ErasureObjects(disks, 4, 2, block_size=1024 * 1024)
+    srv = S3Server(layer, access, secret)
+    port = srv.start()
+    write_cap = 4
+    try:
+        client = S3Client("127.0.0.1", port, access, secret)
+        client.make_bucket("bench")
+        client.make_bucket("healbkt")
+        rng = np.random.default_rng(6)
+        body = rng.integers(0, 256, 1024 * 1024).astype(
+            np.uint8).tobytes()
+        for i in range(4):  # warm compile/caches
+            client.put_object("bench", f"warm-{i}", body)
+
+        # -- brownout: loadgen at ~4x the write cap ---------------------
+        srv.config.set_kv(f"api requests_max_write={write_cap} "
+                          "requests_deadline=250ms")
+        brown = run_load("127.0.0.1", port, access, secret, "bench",
+                         concurrency=4 * write_cap, duration=4.0,
+                         put_fraction=1.0, object_bytes=len(body))
+        srv.config.set_kv("api requests_max_write=0 "
+                          "requests_deadline=10s")
+
+        def put_lat(tag: str, n: int = 14) -> list[float]:
+            lat = []
+            for i in range(n):
+                t0 = time.perf_counter()
+                r = client.put_object("bench", f"{tag}-{i}", body)
+                lat.append(time.perf_counter() - t0)
+                if r.status != 200:
+                    raise RuntimeError(f"PUT failed: {r.status}")
+            return lat
+
+        # -- heal interference ------------------------------------------
+        # off -> on -> off: the two baselines bracket the measurement
+        # so page-cache/VM drift doesn't masquerade as interference.
+        for i in range(16):
+            client.put_object("healbkt", f"obj-{i}", body)
+        lat_off = put_lat("off1")
+        stop = threading.Event()
+
+        def heal_forever():
+            import shutil as _sh
+            while not stop.is_set():
+                for i in range(16):  # re-damage so the sweep never idles
+                    _sh.rmtree(os.path.join(root, "disk0", "healbkt",
+                                            f"obj-{i}"),
+                               ignore_errors=True)
+                layer.healer.heal_disk(0)
+
+        ht = threading.Thread(target=heal_forever, daemon=True)
+        ht.start()
+        time.sleep(0.3)  # let the sweep reach steady state
+        lat_on = put_lat("on")
+        stop.set()
+        ht.join(timeout=60)
+        lat_off += put_lat("off2")
+        p50_off = stats.median(lat_off) * 1e3
+        p50_on = stats.median(lat_on) * 1e3
+        from minio_tpu.obs.metrics2 import METRICS2
+        return {
+            "metric": "qos_brownout",
+            "value": brown["shed_rate"], "unit": "shed_rate",
+            "write_cap": write_cap,
+            "overload_concurrency": 4 * write_cap,
+            "requests": brown["requests"], "ok": brown["ok"],
+            "shed_503": brown["shed_503"],
+            "retry_after_headers": brown["retry_after_headers"],
+            "admitted_p50_ms": brown["latency_ms"]["p50"],
+            "admitted_p99_ms": brown["latency_ms"]["p99"],
+            "put_p50_heal_off_ms": round(p50_off, 3),
+            "put_p50_heal_on_ms": round(p50_on, 3),
+            "heal_interference_ratio": round(p50_on / max(p50_off, 1e-9),
+                                             3),
+            "bg_deferrals": METRICS2.get(
+                "minio_tpu_v2_qos_bg_deferrals_total"),
+            "bg_promotions": METRICS2.get(
+                "minio_tpu_v2_qos_bg_promotions_total"),
+        }
+    finally:
+        srv.stop()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 class _DeviceHunt(threading.Thread):
     """Background device acquisition for the WHOLE bench run.
 
@@ -536,7 +649,9 @@ def main() -> None:
                      ("multipart", lambda: bench_multipart(np, workdir)),
                      ("get_2lost",
                       lambda: bench_get_with_loss(np, workdir, False)),
-                     ("heal", lambda: bench_heal(np, workdir, False))):
+                     ("heal", lambda: bench_heal(np, workdir, False)),
+                     ("qos_brownout",
+                      lambda: bench_qos_brownout(np, workdir))):
         _progress(f"config {name} (host mode)")
         res, err = _retrying(fn, name, attempts=2, base_sleep=1.0)
         if res is not None:

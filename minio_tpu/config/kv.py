@@ -31,6 +31,12 @@ DEFAULT_KVS: dict[str, dict[str, str]] = {
     "api": {
         "requests_max": "0",
         "requests_deadline": "10s",
+        # QoS per-class admission caps (0 = unlimited); the global
+        # requests_max still bounds the sum (minio_tpu/qos/admission.py).
+        "requests_max_read": "0",
+        "requests_max_write": "0",
+        "requests_max_list": "0",
+        "requests_max_admin": "0",
         "cors_allow_origin": "*",
     },
     "compression": {

@@ -110,18 +110,25 @@ class Healer:
         is read-only, so it runs under the READ lock — sweeping a mostly
         healthy namespace never stalls client traffic. Only when repair
         is actually needed does the heal escalate to the write lock and
-        re-classify under it (the state may have changed in between)."""
-        with self.engine.ns_lock.read_locked(bucket, object_name,
-                                             lock_timeout):
-            res = self._heal_object_locked(bucket, object_name,
-                                           dry_run=True)
-        if (dry_run or res.dangling
-                or not (res.corrupt_disks or res.missing_disks)):
-            return res
-        with self.engine.ns_lock.write_locked(bucket, object_name,
-                                              lock_timeout):
-            return self._heal_object_locked(bucket, object_name,
-                                            dry_run=False)
+        re-classify under it (the state may have changed in between).
+
+        Dispatch priority: every heal entry point funnels here, so the
+        whole operation runs in the BACKGROUND lane — its batched
+        reconstructs yield the device/coalescing window to foreground
+        encode work (qos/scheduler.py), with aging against starvation."""
+        from ..qos.scheduler import background_lane
+        with background_lane():
+            with self.engine.ns_lock.read_locked(bucket, object_name,
+                                                 lock_timeout):
+                res = self._heal_object_locked(bucket, object_name,
+                                               dry_run=True)
+            if (dry_run or res.dangling
+                    or not (res.corrupt_disks or res.missing_disks)):
+                return res
+            with self.engine.ns_lock.write_locked(bucket, object_name,
+                                                  lock_timeout):
+                return self._heal_object_locked(bucket, object_name,
+                                                dry_run=False)
 
     def heal_object_or_queue(self, bucket: str, object_name: str,
                              dry_run: bool = False) -> HealResult:
@@ -372,18 +379,33 @@ class Healer:
 
     def heal_disk(self, disk_index: int) -> list[HealResult]:
         """Full sweep healing everything onto one (fresh) disk
-        (ref healErasureSet / monitorLocalDisksAndHeal)."""
+        (ref healErasureSet / monitorLocalDisksAndHeal). The listing
+        walk between per-object heals also runs in the background lane
+        (per-object heals re-enter it via heal_object)."""
+        from ..qos.scheduler import background_lane
+        with background_lane():
+            return self._heal_disk_bg(disk_index)
+
+    def _heal_disk_bg(self, disk_index: int) -> list[HealResult]:
+        from ..qos.scheduler import GATE
         eng = self.engine
         results = []
+        last_cost = None
         for binfo in eng.list_buckets():
             bucket = binfo["name"]
             self.heal_bucket(bucket)
             for obj in eng.list_objects(bucket, max_keys=1_000_000):
+                # Pace the sweep against foreground traffic (ref
+                # waitForLowHTTPReq + dynamicSleeper): per-object heal
+                # is I/O+hash heavy; yield ~10x the last object's own
+                # cost between objects, aging-bounded.
+                GATE.throttle_background(last_cost)
                 # Per-object isolation: one failing object (lock
                 # timeout, peer flapping mid-sweep) must not abort the
                 # rest of the sweep — it starved convergence when an
                 # early object kept failing while later ones never got
                 # reached; the next sweep retries it anyway.
+                t0 = time.monotonic()
                 try:
                     r = self.heal_object_or_queue(bucket, obj.name)
                 except Exception as exc:  # noqa: BLE001 — sweep survives
@@ -392,6 +414,8 @@ class Healer:
                         "heal sweep: %s/%s failed: %r", bucket,
                         obj.name, exc)
                     continue
+                finally:
+                    last_cost = time.monotonic() - t0
                 if disk_index in r.healed_disks or not r.healed_disks:
                     results.append(r)
         return results
@@ -591,9 +615,12 @@ class MRFQueue:
     LOCK_WAIT_S = 3.0
 
     def _heal(self, item) -> None:
+        from ..qos.scheduler import GATE, background_lane
         bucket, object_name, tries = (item if len(item) == 3
                                       else (*item, 0))
         try:
+            with background_lane():
+                GATE.throttle_background()  # MRF drains behind traffic
             self.healer.heal_object(bucket, object_name,
                                     lock_timeout=self.LOCK_WAIT_S)
         except TimeoutError:

@@ -181,18 +181,36 @@ class MetacacheManager:
                            else -1)
                 key = (bucket, root)
                 force = self._peer_fetch_counters.get(key) != counter
-                self._peer_fetch_counters[key] = counter
+                # The counter snapshot is recorded only after the
+                # owner actually SERVES the first forced page
+                # (_peer_then_local) — recording it here would let a
+                # never-iterated or transport-failed listing swallow
+                # the owner-cache invalidation and serve stale
+                # read-after-write results (ADVICE r5). A concurrent
+                # stale overwrite can only force one extra rescan,
+                # never skip one.
                 return self._peer_then_local(share, owner, bucket,
-                                             root, after, force)
+                                             root, after, force,
+                                             key, counter)
         return self._entries_local(bucket, root)
 
+    def _mark_peer_fetched(self, key, counter) -> None:
+        """A forced owner fetch completed: writes up to `counter` are
+        now reflected in the owner's cache."""
+        if key is not None:
+            self._peer_fetch_counters[key] = counter
+
     def _peer_then_local(self, share, owner: str, bucket: str,
-                         root: str, after: str, force: bool = False):
+                         root: str, after: str, force: bool = False,
+                         key=None, counter=None):
         """Stream the owner's entries; on ANY transport failure —
         first page or mid-stream — continue from a local scan at the
         last yielded name, so an owner crash degrades a listing to a
         local walk instead of failing it (availability beats the
-        shared-scan optimization)."""
+        shared-scan optimization). The fetch-counter snapshot commits
+        only once the owner has actually served the first page (an
+        empty-but-successful listing counts) — a failed or abandoned
+        forced fetch keeps the force sticky for the next listing."""
         last = after
         it = share.fetch_entries(owner, self.share_id, bucket, root,
                                  after=after, force=force)
@@ -201,6 +219,10 @@ class MetacacheManager:
             try:
                 e = next(it)
             except StopIteration:
+                if not served:
+                    # Owner answered (empty page): the force was
+                    # delivered; commit the snapshot.
+                    self._mark_peer_fetched(key, counter)
                 return
             except Exception:
                 for e2 in self._entries_local(bucket, root):
@@ -210,6 +232,7 @@ class MetacacheManager:
             if not served:
                 served = True
                 self.peer_serves += 1
+                self._mark_peer_fetched(key, counter)
             last = e["name"]
             yield e
 

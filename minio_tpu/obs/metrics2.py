@@ -283,3 +283,28 @@ METRICS2.register(
 METRICS2.register(
     "minio_tpu_v2_cluster_nodes", "gauge",
     "Nodes contributing to a cluster metrics scrape.")
+METRICS2.register(
+    "minio_tpu_v2_qos_admission_inflight", "gauge",
+    "In-flight admitted requests, by API class.")
+METRICS2.register(
+    "minio_tpu_v2_qos_admission_queue_depth", "gauge",
+    "Requests waiting in the admission queue, by API class.")
+METRICS2.register(
+    "minio_tpu_v2_qos_admission_wait_ms", "histogram",
+    "Admission wait time in milliseconds, by API class "
+    "(shed waits included).")
+METRICS2.register(
+    "minio_tpu_v2_qos_shed_total", "counter",
+    "Requests shed with 503 SlowDown, by API class and reason.")
+METRICS2.register(
+    "minio_tpu_v2_qos_deadline_expired_total", "counter",
+    "Request deadline expiries, by where the budget ran out.")
+METRICS2.register(
+    "minio_tpu_v2_qos_dispatch_total", "counter",
+    "Batching-layer dispatches, by priority lane (fg/bg).")
+METRICS2.register(
+    "minio_tpu_v2_qos_bg_deferrals_total", "counter",
+    "Background dispatch deferral slices yielded to foreground work.")
+METRICS2.register(
+    "minio_tpu_v2_qos_bg_promotions_total", "counter",
+    "Background dispatches promoted past busy foreground (aging).")

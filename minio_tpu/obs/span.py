@@ -33,6 +33,9 @@ _current: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
 # without bound — the tail is dropped and counted in `dropped`.
 MAX_CHILDREN = 64
 
+# Per-span event cap (QoS shed/deadline markers): same bounding rule.
+MAX_EVENTS = 16
+
 
 class _Noop:
     """Shared do-nothing span context (the untraced fast path)."""
@@ -58,8 +61,8 @@ class Span:
     """
 
     __slots__ = ("trace_id", "span_id", "parent_id", "name", "start",
-                 "duration_ms", "tags", "children", "dropped", "_t0",
-                 "_token", "_tracer", "_done")
+                 "duration_ms", "tags", "children", "events", "dropped",
+                 "_t0", "_token", "_tracer", "_done")
 
     _seq = 0
     _seq_mu = threading.Lock()
@@ -77,6 +80,7 @@ class Span:
         self.duration_ms = 0.0
         self.tags = tags or {}
         self.children: list = []  # Span | dict (grafted remote spans)
+        self.events: list = []    # point-in-time markers (QoS shed, ...)
         self.dropped = 0
         self._t0 = time.perf_counter()
         self._token = None
@@ -95,6 +99,17 @@ class Span:
             return
         self.children.append(child)
 
+    def add_event(self, name: str, **attrs) -> None:
+        """Record a point-in-time marker on this span (admission shed,
+        deadline expiry). Bounded like children; append is GIL-atomic."""
+        if len(self.events) >= MAX_EVENTS:
+            self.dropped += 1
+            return
+        ev = {"name": name, "time": time.time()}
+        if attrs:
+            ev.update(attrs)
+        self.events.append(ev)
+
     def to_dict(self) -> dict:
         d = {
             "traceId": self.trace_id, "spanId": self.span_id,
@@ -104,6 +119,8 @@ class Span:
         }
         if self.tags:
             d["tags"] = dict(self.tags)
+        if self.events:
+            d["events"] = [dict(e) for e in self.events[:MAX_EVENTS]]
         kids = self.children
         dropped = self.dropped
         if len(kids) > MAX_CHILDREN:  # racy appends past the cap
@@ -230,6 +247,17 @@ def sanitize_remote(node, _depth: int = 0,
             for k, v in list(tags.items())[:16]}
     elif "tags" in out:
         del out["tags"]
+    events = node.get("events")
+    if isinstance(events, list):
+        kept_ev = []
+        for e in events[:MAX_EVENTS]:
+            if isinstance(e, dict):
+                kept_ev.append({
+                    str(k)[:64]: (v if isinstance(v, (int, float, bool))
+                                  else str(v)[:256])
+                    for k, v in list(e.items())[:8]})
+        if kept_ev:
+            out["events"] = kept_ev
     kids = node.get("children")
     if isinstance(kids, list) and _depth < MAX_REMOTE_DEPTH:
         kept = []

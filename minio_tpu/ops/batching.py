@@ -241,6 +241,11 @@ def reconstruct_blocks(blocks: list[list[np.ndarray | None]], k: int,
         S = int(np.asarray(shards[avail[0]]).shape[-1])
         groups.setdefault((avail, missing, S), []).append(bi)
 
+    # Priority lanes (qos/scheduler.py): a heal/crawler reconstruct
+    # defers its dispatch while foreground GET/PUT work is busy; aging
+    # promotes it after a bounded wait so background never starves.
+    from ..qos import scheduler as qos_sched
+    lane = qos_sched.current_lane()
     for (avail, missing, S), idxs in groups.items():
         mat, used = any_decode_matrix(k, m, avail, missing)
         # One flat stack + reshape: the nested per-block stack built 64
@@ -250,19 +255,21 @@ def reconstruct_blocks(blocks: list[list[np.ndarray | None]], k: int,
             np.asarray(blocks[bi][j], dtype=np.uint8)
             for bi in idxs for j in used]).reshape(
                 len(idxs), len(used), S)
-        if use_device(stack.nbytes):
-            try:
-                rebuilt = _device_reconstruct(stack, k, m, avail, missing)
-                STATS.add(True, stack.nbytes, len(idxs))
-            except Exception as exc:
-                if not device_fallback:
-                    raise
-                _warn_device_fallback(exc)
+        with qos_sched.GATE.dispatch(lane):
+            if use_device(stack.nbytes):
+                try:
+                    rebuilt = _device_reconstruct(stack, k, m, avail,
+                                                  missing)
+                    STATS.add(True, stack.nbytes, len(idxs))
+                except Exception as exc:
+                    if not device_fallback:
+                        raise
+                    _warn_device_fallback(exc)
+                    rebuilt = _host_reconstruct(stack, mat)
+                    STATS.add(False, stack.nbytes, len(idxs))
+            else:
                 rebuilt = _host_reconstruct(stack, mat)
                 STATS.add(False, stack.nbytes, len(idxs))
-        else:
-            rebuilt = _host_reconstruct(stack, mat)
-            STATS.add(False, stack.nbytes, len(idxs))
         for bn, bi in enumerate(idxs):
             for mi, j in enumerate(missing):
                 out[bi][j] = rebuilt[bn, mi]
@@ -346,22 +353,30 @@ class EncodeCoalescer:
         self._stopped = False
 
     def encode(self, blocks: np.ndarray, k: int, m: int) -> np.ndarray:
-        """Blocking encode: (B, k, S) data -> (B, k+m, S) all shards."""
-        req = _EncodeRequest(np.ascontiguousarray(blocks, dtype=np.uint8),
-                             k, m)
-        self._ensure_thread()
-        self._q.put(req)
-        # Liveness-checked wait: if the dispatcher dies (or a stop()
-        # race eats the queue), fall back to host encode rather than
-        # hanging the PUT handler forever.
-        while not req.done.wait(0.25):
-            t = self._thread
-            if t is None or not t.is_alive():
-                req.declined = True
-                break
-        if req.declined or req.result is None:
-            return host_encode(req.blocks, k, m)
-        return req.result
+        """Blocking encode: (B, k, S) data -> (B, k+m, S) all shards.
+
+        Priority lanes (qos/scheduler.py): a background caller (heal,
+        crawler-driven rewrite) yields the coalescing window — it
+        defers submission while foreground PUT encodes are busy, so the
+        window batches client traffic, not repair traffic; aging
+        promotes it after a bounded wait."""
+        from ..qos import scheduler as qos_sched
+        with qos_sched.GATE.dispatch(qos_sched.current_lane()):
+            req = _EncodeRequest(
+                np.ascontiguousarray(blocks, dtype=np.uint8), k, m)
+            self._ensure_thread()
+            self._q.put(req)
+            # Liveness-checked wait: if the dispatcher dies (or a
+            # stop() race eats the queue), fall back to host encode
+            # rather than hanging the PUT handler forever.
+            while not req.done.wait(0.25):
+                t = self._thread
+                if t is None or not t.is_alive():
+                    req.declined = True
+                    break
+            if req.declined or req.result is None:
+                return host_encode(req.blocks, k, m)
+            return req.result
 
     def _ensure_thread(self) -> None:
         if self._thread is not None and self._thread.is_alive():

@@ -87,6 +87,26 @@ MULTICORE = (_os.cpu_count() or 1) > 1
 FORCE_THREADS = False
 
 
+def _qos_ctx_wrap(fn: Callable) -> Callable:
+    """Carry the caller's QoS context — request deadline and dispatch
+    lane — onto pool workers. Contextvars do not cross threads, so
+    without this a shard fan-out would run deadline-UNCAPPED remote
+    I/O (and heal's fan-outs would lose their background tag) — the
+    same cross-thread gap obs spans close by explicit parent passing.
+    Returns fn unchanged on the default context (no wrap overhead)."""
+    from ..qos import deadline as _dl
+    from ..qos import scheduler as _sched
+    ddl = _dl.current_deadline()
+    lane = _sched.current_lane()
+    if ddl is None and lane == _sched.FOREGROUND:
+        return fn
+
+    def wrapped(*a, **kw):
+        with _dl.deadline_scope(ddl), _sched.lane_scope(lane):
+            return fn(*a, **kw)
+    return wrapped
+
+
 def submit(fn: Callable[..., Any], *args) -> Any:
     """Run one callable on the shared pool; returns its Future (or a
     pre-completed one, executed inline, when the pool is saturated).
@@ -101,7 +121,7 @@ def submit(fn: Callable[..., Any], *args) -> Any:
         except BaseException as e:  # noqa: BLE001 — surfaced by result()
             fut.set_exception(e)
         return fut
-    f = _pool().submit(fn, *args)
+    f = _pool().submit(_qos_ctx_wrap(fn), *args)
     f.add_done_callback(lambda _f: _release(1))
     return f
 
@@ -137,7 +157,7 @@ def parallel_map(fns: Sequence[Callable[[], Any]],
     if len(fns) > 1 and (MULTICORE or FORCE_THREADS):
         granted = _borrow(len(fns) - 1)
         pool = _pool()
-        futures = {pool.submit(fn): i for i, fn in
+        futures = {pool.submit(_qos_ctx_wrap(fn)): i for i, fn in
                    enumerate(fns[:granted])}
         for i, fn in enumerate(fns[granted:-1]):
             run_inline(granted + i, fn)

@@ -175,6 +175,13 @@ class RemoteStorage(StorageAPI):
               payload: bytes = b"") -> tuple[dict, bytes]:
         a = {"disk": self.disk_path}
         a.update(args or {})
+        # Deadline fast-fail: a shard fan-out whose request budget is
+        # spent skips the remote I/O entirely (the transport would
+        # refuse too, but this avoids even building the span).
+        from ..qos.deadline import current_deadline
+        ddl = current_deadline()
+        if ddl is not None:
+            ddl.check(f"rpc.storage.{method}")
         from ..obs.span import TRACER, current_span
         if current_span() is None:  # untraced fast path: no tag work
             return self.client.call("storage", method, a, payload)

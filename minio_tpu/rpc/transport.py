@@ -27,6 +27,9 @@ import socket
 import threading
 import time
 
+from ..qos.deadline import (H_DEADLINE, Deadline, DeadlineExceeded,
+                            current_deadline, deadline_scope,
+                            record_expiry)
 from ..storage import errors as serr
 
 RPC_PREFIX = "/minio-tpu/rpc/v1"
@@ -41,6 +44,7 @@ _ERR_TYPES = {
     "VersionNotFound": serr.VersionNotFound,
     "FileCorrupt": serr.FileCorrupt,
     "DiskFull": serr.DiskFull,
+    "DeadlineExceeded": DeadlineExceeded,
 }
 
 
@@ -66,8 +70,13 @@ def unframe(body: bytes) -> tuple[bytes, bytes]:
 
 def error_to_wire(e: BaseException) -> tuple[int, bytes]:
     name = type(e).__name__
-    status = 404 if isinstance(e, (serr.FileNotFound, serr.VolumeNotFound,
-                                   serr.VersionNotFound)) else 500
+    if isinstance(e, (serr.FileNotFound, serr.VolumeNotFound,
+                      serr.VersionNotFound)):
+        status = 404
+    elif isinstance(e, DeadlineExceeded):
+        status = 503  # retryable: the CALLER's budget ran out
+    else:
+        status = 500
     return status, json.dumps({"error_type": name,
                                "message": str(e)}).encode()
 
@@ -171,6 +180,23 @@ class RPCClient:
         can never knock a healthy peer out of the data plane."""
         if not self.is_online():
             raise serr.DiskNotFound(f"{self.endpoint()} offline")
+        # Deadline propagation (qos/deadline.py): a request whose
+        # budget is already spent must not burn peer capacity — fail
+        # here. Otherwise forward the REMAINING budget so the peer can
+        # refuse expired work, and cap the socket timeout to it so a
+        # slow peer call cancels when the deadline expires instead of
+        # holding the handler for the full transport timeout.
+        ddl = current_deadline()
+        eff_timeout = timeout
+        if ddl is not None:
+            rem_s = ddl.remaining()
+            if rem_s <= 0:
+                record_expiry("rpc-client")
+                raise DeadlineExceeded(
+                    f"{service}/{method} to {self.endpoint()}: request "
+                    "deadline exhausted before dispatch")
+            base = timeout if timeout is not None else self.timeout
+            eff_timeout = max(0.05, min(base, rem_s))
         args_json = json.dumps(args, sort_keys=True)
         ts = str(int(time.time()))
         body = frame(args_json.encode(), payload)
@@ -180,6 +206,8 @@ class RPCClient:
                                 ts, args_json, payload),
             "Content-Length": str(len(body)),
         }
+        if ddl is not None:
+            headers[H_DEADLINE] = str(round(ddl.remaining_ms(), 3))
         # Distributed tracing: the caller's trace context rides a tiny
         # header; the peer opens a server-side span under it and ships
         # its subtree back in the reserved _trace_spans result key, so
@@ -191,7 +219,7 @@ class RPCClient:
         if _cur is not None:
             headers["x-mtpu-trace"] = f"{_cur.trace_id}:{_cur.span_id}"
         override = timeout is not None
-        conn, reused = self._get_conn(timeout)
+        conn, reused = self._get_conn(eff_timeout)
         while True:
             t0 = time.monotonic()
             logged = override
@@ -236,8 +264,17 @@ class RPCClient:
                     # error on a fresh socket) never retry, so an RPC
                     # the peer may have executed is never re-sent.
                     self._drop_pool()
-                    conn, reused = self._get_conn(timeout)
+                    conn, reused = self._get_conn(eff_timeout)
                     continue
+                if ddl is not None and ddl.expired():
+                    # The request DEADLINE elapsed, not the peer: the
+                    # socket timeout above was deadline-capped, so say
+                    # nothing about peer health — no offline mark, no
+                    # dynamic-timeout tuning.
+                    record_expiry("rpc-client")
+                    raise DeadlineExceeded(
+                        f"{service}/{method} to {self.endpoint()}: "
+                        f"deadline expired mid-call: {e}")
                 # Only genuine ceiling hits tune the timeout up — an
                 # instant connection-refused says nothing about
                 # slowness.
@@ -304,6 +341,24 @@ class RPCRegistry:
             from ..obs.metrics2 import METRICS2
             METRICS2.inc("minio_tpu_v2_rpc_requests_total",
                          {"service": service_name, "method": method})
+            # Remaining-budget propagation: refuse work whose caller
+            # can no longer use the answer, and re-open the budget so
+            # anything this handler calls in turn (disk I/O, nested
+            # RPC) keeps decrementing the SAME deadline.
+            ddl = None
+            ddl_hdr = headers.get(H_DEADLINE, "")
+            if ddl_hdr:
+                try:
+                    rem_ms = float(ddl_hdr)
+                except ValueError:
+                    rem_ms = None
+                if rem_ms is not None:
+                    if rem_ms <= 0:
+                        record_expiry("rpc-server")
+                        raise DeadlineExceeded(
+                            f"{service_name}/{method}: caller deadline "
+                            "already expired")
+                    ddl = Deadline.from_remaining_ms(rem_ms)
             srv_span = None
             trace_hdr = headers.get("x-mtpu-trace", "")
             if trace_hdr and ":" in trace_hdr:
@@ -315,14 +370,15 @@ class RPCRegistry:
                 tid, _, pid = trace_hdr.partition(":")
                 srv_span = Span(f"rpc.server.{service_name}.{method}",
                                 tid[:64], pid[:32])
-            if srv_span is not None:
-                with srv_span:
+            with deadline_scope(ddl):
+                if srv_span is not None:
+                    with srv_span:
+                        result, rbody = fn(args, payload)
+                    if isinstance(result, dict):
+                        result = dict(result)
+                        result["_trace_spans"] = [srv_span.to_dict()]
+                else:
                     result, rbody = fn(args, payload)
-                if isinstance(result, dict):
-                    result = dict(result)
-                    result["_trace_spans"] = [srv_span.to_dict()]
-            else:
-                result, rbody = fn(args, payload)
             out = frame(json.dumps(result).encode(), rbody)
             return 200, {}, out
         except BaseException as e:  # noqa: BLE001 — serialized to peer

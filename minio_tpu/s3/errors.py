@@ -15,6 +15,10 @@ class APIError(Exception):
     code: str
     description: str
     http_status: int
+    # Throttling family: seconds the client should back off before
+    # retrying; rendered as a Retry-After response header (ref the
+    # reference's 503 SlowDown responses, cmd/generic-handlers.go).
+    retry_after: int | None = None
 
     def xml(self, resource: str = "", request_id: str = "") -> bytes:
         from .xmlutil import Element
@@ -24,6 +28,18 @@ class APIError(Exception):
         e.child("Resource", resource)
         e.child("RequestId", request_id)
         return e.tobytes()
+
+    def headers(self) -> dict[str, str]:
+        """Extra response headers this error carries."""
+        if self.retry_after is not None:
+            return {"Retry-After": str(self.retry_after)}
+        return {}
+
+    def with_retry_after(self, seconds: int) -> "APIError":
+        """A copy carrying a Retry-After hint (module-level error
+        singletons stay immutable-in-practice)."""
+        return APIError(self.code, self.description, self.http_status,
+                        retry_after=max(1, int(seconds)))
 
 
 def _e(code: str, desc: str, status: int) -> APIError:
@@ -83,6 +99,13 @@ ERR_INTERNAL_ERROR = _e(
     "InternalError",
     "We encountered an internal error, please try again.", 500)
 ERR_SLOW_DOWN = _e("SlowDown", "Please reduce your request rate", 503)
+ERR_SERVICE_UNAVAILABLE = _e(
+    "ServiceUnavailable",
+    "The service is unavailable. Please retry.", 503)
+ERR_REQUEST_TIMEOUT = _e(
+    "RequestTimeout",
+    "A timeout occurred while trying to process the request, please "
+    "reduce your request rate", 503)
 ERR_NOT_IMPLEMENTED = _e("NotImplemented",
                          "A header you provided implies functionality "
                          "that is not implemented", 501)

@@ -2148,6 +2148,14 @@ class S3Server:
         self.config = None  # ConfigSys once the layer attaches
         self.audit = None
         self._audit_from_env = False
+        # QoS: per-class admission caps + request deadline budget (ref
+        # maxClients middleware, cmd/generic-handlers.go). Created
+        # before set_layer so _apply_config can configure it, and
+        # registered as the dispatch scheduler's foreground-busy probe.
+        from ..qos.admission import AdmissionController
+        from ..qos.scheduler import GATE
+        self.qos = AdmissionController()
+        GATE.register(self.qos)
         from .webrpc import WebHandlers
         self.web = WebHandlers(self)
         if layer is not None:
@@ -2216,6 +2224,24 @@ class S3Server:
                 if urlparse(ep).scheme not in ("http", "https"):
                     raise ValueError(f"audit endpoint {ep!r} must be "
                                      "http(s)")
+        if subsys == "api":
+            from ..qos.deadline import parse_duration
+            for key, v in kvs.items():
+                if key.startswith("requests_max"):
+                    try:
+                        if int(v) < 0:
+                            raise ValueError
+                    except ValueError:
+                        raise ValueError(
+                            f"api {key}={v!r}: must be an integer >= 0")
+                elif key == "requests_deadline":
+                    try:
+                        if parse_duration(v) < 0:
+                            raise ValueError
+                    except ValueError:
+                        raise ValueError(
+                            f"api requests_deadline={v!r}: must be a "
+                            "duration like 10s / 250ms")
 
     def _apply_config(self, cfg) -> None:
         """Push dynamic config into the running subsystems (the
@@ -2241,6 +2267,19 @@ class S3Server:
             Logger.get().log_once(
                 f"storage_class config invalid, keeping previous: {e}",
                 "config")
+        # Admission caps + deadline reload live (per-class overrides on
+        # top of the reference's single requests_max knob).
+        from ..qos.deadline import parse_duration
+        try:
+            self.qos.configure(
+                int(cfg.get("api", "requests_max") or "0"),
+                {c: int(cfg.get("api", f"requests_max_{c}") or "0")
+                 for c in ("read", "write", "list", "admin")},
+                parse_duration(cfg.get("api", "requests_deadline")))
+        except ValueError as e:  # env override may carry garbage
+            from ..logger import Logger
+            Logger.get().log_once(
+                f"api qos config invalid, keeping previous: {e}", "config")
         ep = cfg.get("audit_webhook", "endpoint")
         tok = cfg.get("audit_webhook", "auth_token")
         if cfg.get("audit_webhook", "enable") == "on" and ep:
@@ -2500,6 +2539,40 @@ class S3Server:
             loc += f"?{req.query}"
         return S3Response(307, headers={"Location": loc})
 
+    def route_qos(self, req: S3Request) -> S3Response:
+        """Admission + deadline wrapper around route (ref the
+        maxClients middleware fronting the router,
+        cmd/generic-handlers.go): classify the request, open its time
+        budget, wait FIFO for a slot within that budget, shed with 503
+        SlowDown + Retry-After past it. The deadline stays current for
+        the whole handler, so storage/peer RPC below sees the remaining
+        budget."""
+        from ..qos import admission as adm
+        from ..qos import deadline as dl
+        api_class = adm.classify(req.method, req.bucket, req.key)
+        budget_s = self.qos.deadline_s if self.qos.engaged else 0.0
+        with dl.open_deadline(budget_s) as budget:
+            try:
+                admitted = self.qos.acquire(api_class, budget)
+            except adm.AdmissionShed as shed:
+                raise s3err.ERR_SLOW_DOWN.with_retry_after(
+                    shed.retry_after)
+            try:
+                resp = self.route(req)
+            except BaseException:
+                admitted.release()
+                raise
+            if isinstance(resp.body, (bytes, bytearray)):
+                admitted.release()
+            else:
+                # Streaming body: the per-group shard reads run LAZILY
+                # while the body writes to the socket — the request is
+                # still consuming its class's capacity. Hold the slot
+                # until _finish_request (which also covers vanished
+                # clients); release() is idempotent.
+                resp.qos_release = admitted.release
+            return resp
+
     def route(self, req: S3Request) -> S3Response:
         h = self.handlers
         if h is None:
@@ -2624,8 +2697,11 @@ class S3Server:
 
     def handle_ops(self, method: str, raw_path: str, query: str,
                    headers: dict[str, str], body: bytes,
-                   ) -> tuple[int, str, bytes]:
-        """Health / metrics / admin routes (non-S3 prefixes)."""
+                   ) -> tuple:
+        """Health / metrics / admin routes (non-S3 prefixes).
+        Returns (status, content_type, body[, extra_headers]) — the
+        4th element is optional and carries response headers (the
+        admin shed path's Retry-After)."""
         import json as _json
         params = dict(urllib.parse.parse_qsl(query,
                                              keep_blank_values=True))
@@ -2671,8 +2747,22 @@ class S3Server:
             except APIError:
                 return 403, "application/json", _json.dumps(
                     {"error": "authentication failed"}).encode()
-            status, out = self.admin.handle(method, raw_path, params,
-                                            body, access_key)
+            # Admin rides its own admission class so a control-plane
+            # storm cannot crowd out data-plane caps (and vice versa).
+            from ..qos import admission as adm
+            from ..qos import deadline as dl
+            _budget_s = self.qos.deadline_s if self.qos.engaged else 0.0
+            with dl.open_deadline(_budget_s) as budget:
+                try:
+                    admitted = self.qos.acquire("admin", budget)
+                except adm.AdmissionShed as shed:
+                    return (503, "application/json", _json.dumps(
+                        {"error": "SlowDown",
+                         "retryAfterSeconds": shed.retry_after}).encode(),
+                        {"Retry-After": str(shed.retry_after)})
+                with admitted:
+                    status, out = self.admin.handle(
+                        method, raw_path, params, body, access_key)
             return status, "application/json", out
         return 404, "text/plain", b"not found"
 
@@ -2969,10 +3059,14 @@ class S3Server:
                     # Health, metrics, admin (ref healthcheck-router.go,
                     # metrics-router.go, admin-router.go).
                     if raw_path.startswith("/minio-tpu/"):
-                        status, ctype, rbody = server.handle_ops(
+                        res = server.handle_ops(
                             self.command, raw_path, query, headers, body)
+                        status, ctype, rbody = res[:3]
                         self.send_response(status)
                         self.send_header("Content-Type", ctype)
+                        for hk, hv in (res[3] if len(res) > 3
+                                       else {}).items():
+                            self.send_header(hk, hv)
                         self.send_header("Content-Length", str(len(rbody)))
                         self.end_headers()
                         if rbody:
@@ -2994,16 +3088,18 @@ class S3Server:
                     if root_span is not None:
                         root_span.__enter__()
                     try:
-                        resp = server.route(req)
+                        resp = server.route_qos(req)
                     except APIError as e:
                         resp = None
                         if getattr(e, "code", "") == "NoSuchBucket":
                             resp = server._federation_redirect(req)
                         if resp is None:
+                            hdrs = {"Content-Type": "application/xml"}
+                            hdrs.update(e.headers())
                             resp = S3Response(
                                 e.http_status,
                                 e.xml(raw_path, req.request_id),
-                                {"Content-Type": "application/xml"})
+                                hdrs)
                     except (QuorumError, TimeoutError) as e:
                         # Quorum races/outages and lock-acquire
                         # timeouts are RETRYABLE: 503 SlowDown,
@@ -3011,16 +3107,23 @@ class S3Server:
                         # InsufficientWriteQuorum/OperationTimedOut ->
                         # ErrSlowDown (cmd/api-errors.go:1898). Clients
                         # with standard retry policies recover
-                        # transparently.
+                        # transparently. A burnt request DEADLINE is
+                        # the same family but its own code: 503
+                        # RequestTimeout (ref ErrOperationTimedOut).
                         from ..logger import Logger
+                        from ..qos.deadline import DeadlineExceeded
                         Logger.get().log_once(
                             f"{self.command} {raw_path}: quorum: {e}",
                             "s3-handler")
-                        err = s3err.ERR_SLOW_DOWN
+                        err = (s3err.ERR_REQUEST_TIMEOUT
+                               if isinstance(e, DeadlineExceeded)
+                               else s3err.ERR_SLOW_DOWN
+                               ).with_retry_after(1)
                         resp = S3Response(
                             err.http_status,
                             err.xml(raw_path, req.request_id),
-                            {"Content-Type": "application/xml"})
+                            {"Content-Type": "application/xml",
+                             **err.headers()})
                     except Exception as e:  # noqa: BLE001
                         if isinstance(e, APIError):
                             raise
@@ -3067,6 +3170,9 @@ class S3Server:
                         if _finished[0]:
                             return
                         _finished[0] = True
+                        qos_release = getattr(resp, "qos_release", None)
+                        if qos_release is not None:
+                            qos_release()  # streaming body done: free
                         if root_span is not None and trace_tree is None:
                             trace_tree = root_span.finish()
                         dur_ms = (time.monotonic() - t0) * 1000.0

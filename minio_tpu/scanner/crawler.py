@@ -108,7 +108,15 @@ class DataCrawler:
     # -- one cycle ------------------------------------------------------
 
     def crawl_once(self, now: float | None = None) -> dict:
-        now = time.time() if now is None else now
+        # The whole cycle (usage walk, lifecycle rewrites, sampled heal
+        # verification) is background work: its kernel dispatches yield
+        # to foreground traffic via the QoS lanes (qos/scheduler.py).
+        from ..qos.scheduler import background_lane
+        with background_lane():
+            return self._crawl_once_bg(time.time() if now is None
+                                       else now)
+
+    def _crawl_once_bg(self, now: float) -> dict:
         usage: dict = {"lastUpdate": now, "buckets": {}}
         full_sweep = (self.cycles % self.full_cycle_every == 0)
         for b in self.layer.list_buckets():
@@ -277,6 +285,10 @@ class DataCrawler:
         self._counter += 1
         if self._counter % self.heal_sample:
             return
+        # Sampled deep verify is the crawl's expensive step: pace it
+        # against foreground traffic (ref waitForLowHTTPReq).
+        from ..qos.scheduler import GATE
+        GATE.throttle_background()
         healer = getattr(self.layer, "healer", None)
         if healer is None:
             return

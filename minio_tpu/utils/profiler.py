@@ -63,7 +63,11 @@ class SamplingProfiler:
             self._thread = None
         return self.report()
 
-    def report(self, top: int = 50) -> dict:
+    # Wide enough that briefly-active request handlers still make the
+    # table: every PARKED thread's wait frames count on every sample,
+    # and a long-lived process holds dozens of parked stacks — a
+    # 50-row table was all idle frames under full-suite load.
+    def report(self, top: int = 100) -> dict:
         def rows(counter: Counter) -> list[dict]:
             total = max(1, self.samples)
             return [{

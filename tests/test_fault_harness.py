@@ -331,9 +331,22 @@ def test_hot_single_drive_swap_heals_without_restart(cluster):
     for k, b in bodies.items():
         _put_ok(c, "fault-swap", k, b)
     target = cluster.disk_dirs(2)[0]
-    # Every disk holds one shard per object (6 disks, EC 3+3).
+    # Precondition: every disk holds one shard per object (6 disks,
+    # EC 3+3). The PREVIOUS test restarted node 2, so node 0's peer
+    # health gate (OFFLINE_RETRY) may still skip node 2's disks on the
+    # first writes — quorum 4/6 succeeds without them. Re-PUT until
+    # placement is complete; the gate reopens within ~2s.
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        missing = [k for k in bodies
+                   if len(_shard_files([target], "fault-swap", k)) != 1]
+        if not missing:
+            break
+        for k in missing:
+            _put_ok(c, "fault-swap", k, bodies[k])
+        time.sleep(1)
     assert all(len(_shard_files([target], "fault-swap", k)) == 1
-               for k in bodies)
+               for k in bodies), "full shard placement never converged"
 
     shutil.rmtree(target)          # hot drive swap: node keeps running
     os.makedirs(target)

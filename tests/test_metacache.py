@@ -372,3 +372,79 @@ def test_walk_dir_iter_fuzz_order_and_resume(tmp_path):
         resumed = [e["name"]
                    for e in local.walk_dir_iter("fz", after=got[i])]
         assert resumed == got[i + 1:], got[i]
+
+
+def test_peer_fetch_counter_commits_only_after_forced_page(tmp_path):
+    """ADVICE r5 race: _entries_for must NOT record the tracker counter
+    before the owner has actually served the first forced page — a
+    never-iterated listing, a transport failure, or a concurrent
+    listing would otherwise swallow the owner-cache invalidation and
+    serve stale read-after-write results. The snapshot commits inside
+    _peer_then_local once the first entry (or a clean empty page)
+    arrives."""
+    from minio_tpu.listing.metacache import MetacacheManager
+
+    class _Tracker:
+        counter = 1
+        cycle = 0
+
+        def bucket_counter(self, bucket):
+            return self.counter
+
+    class _Eng:
+        update_tracker = _Tracker()
+        disks = []
+        k = 1
+
+    class _Share:
+        """Owner stub recording force flags; programmable failure."""
+
+        def __init__(self):
+            self.fetches = []
+            self.fail_next = False
+            self.entries = [{"name": "a", "versions": []}]
+
+        def owner_key(self, bucket, root):
+            return "peer-1"
+
+        def fetch_entries(self, owner, share_id, bucket, root,
+                          after="", force=False):
+            self.fetches.append(bool(force))
+            if self.fail_next:
+                self.fail_next = False
+                raise ConnectionError("owner down")
+            yield from self.entries
+
+    mgr = MetacacheManager(_Eng())
+    share = _Share()
+    mgr.peer_share = share
+    mgr._entries_local = lambda bucket, root: []  # fallback stub
+
+    # 1. A never-iterated listing must not eat the invalidation.
+    gen = mgr._entries_for("mb", "")
+    del gen  # caller abandoned the listing before the first page
+    assert share.fetches == []  # lazy: owner never contacted
+    assert list(mgr._entries_for("mb", "")) == share.entries
+    assert share.fetches == [True]  # force survived the abandonment
+
+    # 2. Committed: an unchanged counter no longer forces.
+    assert list(mgr._entries_for("mb", "")) == share.entries
+    assert share.fetches == [True, False]
+
+    # 3. A transport-failed forced fetch keeps the force sticky.
+    _Eng.update_tracker.counter = 2  # a write through this node
+    share.fail_next = True
+    assert list(mgr._entries_for("mb", "")) == []  # local fallback
+    assert share.fetches == [True, False, True]
+    assert list(mgr._entries_for("mb", "")) == share.entries
+    assert share.fetches == [True, False, True, True]  # forced AGAIN
+    assert list(mgr._entries_for("mb", "")) == share.entries
+    assert share.fetches[-1] is False  # committed after success
+
+    # 4. An empty-but-successful forced page also commits.
+    _Eng.update_tracker.counter = 3
+    share.entries = []
+    assert list(mgr._entries_for("mb", "")) == []
+    assert share.fetches[-1] is True
+    assert list(mgr._entries_for("mb", "")) == []
+    assert share.fetches[-1] is False

@@ -1,0 +1,200 @@
+"""Threaded S3 load generator: target QPS (or closed-loop), mixed
+PUT/GET, latency percentiles on stdout. Dependency-free — drives the
+server with the same stdlib SigV4 client the test suite uses.
+
+Used by tests/test_qos.py and the bench.py `qos_brownout` config to
+prove the admission layer sheds with 503 SlowDown under overload
+instead of queueing unboundedly.
+
+CLI:
+    python -m tools.loadgen --port 9000 --bucket bench \\
+        --concurrency 16 --duration 5 --put-fraction 0.5 --size 1048576
+
+Library:
+    from tools.loadgen import run_load
+    report = run_load("127.0.0.1", port, access, secret, "bench", ...)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import threading
+import time
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile of a pre-sorted list (0 when empty)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q / 100.0 * len(sorted_vals))) - 1))
+    return sorted_vals[idx]
+
+
+class _Pacer:
+    """Token pacing toward a target QPS; qps <= 0 = closed loop (each
+    worker fires as fast as its previous request completes)."""
+
+    def __init__(self, qps: float):
+        self.qps = qps
+        self._mu = threading.Lock()
+        self._next = time.monotonic()
+
+    def wait(self) -> None:
+        if self.qps <= 0:
+            return
+        with self._mu:
+            now = time.monotonic()
+            slot = max(self._next, now)
+            self._next = slot + 1.0 / self.qps
+        delay = slot - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+
+
+def run_load(host: str, port: int, access_key: str, secret_key: str,
+             bucket: str, *, concurrency: int = 8, duration: float = 5.0,
+             qps: float = 0.0, put_fraction: float = 0.5,
+             object_bytes: int = 1024 * 1024, key_prefix: str = "loadgen",
+             key_space: int = 32, seed: int = 0) -> dict:
+    """Drive mixed PUT/GET load; returns the aggregate report dict.
+
+    GETs address keys the run has already PUT (a GET before any PUT
+    completes falls back to a PUT), so the mix self-bootstraps on an
+    empty bucket. Latencies are per-request wall time in milliseconds;
+    every non-2xx status is counted by code, 503s also by error code
+    parsed from the XML body (SlowDown vs RequestTimeout)."""
+    from minio_tpu.s3.client import S3Client
+
+    body = bytes(bytearray(random.Random(seed).randbytes(object_bytes))
+                 ) if object_bytes else b""
+    pacer = _Pacer(qps)
+    stop_at = time.monotonic() + duration
+    mu = threading.Lock()
+    lat_ok: list[float] = []
+    lat_shed: list[float] = []
+    status_counts: dict[int, int] = {}
+    error_codes: dict[str, int] = {}
+    put_keys: list[str] = []
+    retry_after_seen = 0
+
+    def worker(wid: int) -> None:
+        nonlocal retry_after_seen
+        rng = random.Random(seed * 1000 + wid)
+        client = S3Client(host, port, access_key, secret_key)
+        while time.monotonic() < stop_at:
+            pacer.wait()
+            do_put = rng.random() < put_fraction or not put_keys
+            key = f"{key_prefix}/{wid}-{rng.randrange(key_space)}"
+            t0 = time.perf_counter()
+            try:
+                if do_put:
+                    r = client.put_object(bucket, key, body)
+                else:
+                    with mu:
+                        gkey = rng.choice(put_keys)
+                    r = client.get_object(bucket, gkey)
+                status = r.status
+            except Exception:
+                status = -1
+                r = None
+            ms = (time.perf_counter() - t0) * 1e3
+            with mu:
+                status_counts[status] = status_counts.get(status, 0) + 1
+                if 200 <= status < 300:
+                    lat_ok.append(ms)
+                    if do_put:
+                        put_keys.append(key)
+                else:
+                    lat_shed.append(ms)
+                    if r is not None and status >= 400:
+                        code = _xml_code(r.body)
+                        error_codes[code] = error_codes.get(code, 0) + 1
+                        if "retry-after" in r.headers:
+                            retry_after_seen += 1
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(concurrency)]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(duration + 60)
+    elapsed = time.monotonic() - t_start
+
+    lat_ok.sort()
+    total = sum(status_counts.values())
+    ok = len(lat_ok)
+    shed = status_counts.get(503, 0)
+    return {
+        "requests": total,
+        "ok": ok,
+        "shed_503": shed,
+        "shed_rate": round(shed / total, 4) if total else 0.0,
+        "errors_other": total - ok - shed,
+        "status_counts": {str(k): v for k, v in
+                          sorted(status_counts.items())},
+        "error_codes": dict(sorted(error_codes.items())),
+        "retry_after_headers": retry_after_seen,
+        "qps_achieved": round(total / elapsed, 2) if elapsed else 0.0,
+        "latency_ms": {
+            "p50": round(_percentile(lat_ok, 50), 3),
+            "p90": round(_percentile(lat_ok, 90), 3),
+            "p99": round(_percentile(lat_ok, 99), 3),
+            "max": round(lat_ok[-1], 3) if lat_ok else 0.0,
+        },
+        "elapsed_s": round(elapsed, 3),
+        "config": {"concurrency": concurrency, "duration_s": duration,
+                   "qps_target": qps, "put_fraction": put_fraction,
+                   "object_bytes": object_bytes},
+    }
+
+
+def _xml_code(body: bytes) -> str:
+    """<Code>X</Code> out of an S3 error body, tag-sliced so the parser
+    never chokes on a truncated response."""
+    try:
+        text = body.decode("utf-8", "replace")
+        start = text.find("<Code>")
+        end = text.find("</Code>")
+        if 0 <= start < end:
+            return text[start + len("<Code>"):end]
+    except Exception:
+        pass
+    return "unknown"
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--access-key", default="minioadmin")
+    p.add_argument("--secret-key", default="minioadmin")
+    p.add_argument("--bucket", default="loadgen")
+    p.add_argument("--concurrency", type=int, default=8)
+    p.add_argument("--duration", type=float, default=5.0)
+    p.add_argument("--qps", type=float, default=0.0,
+                   help="target QPS; 0 = closed loop")
+    p.add_argument("--put-fraction", type=float, default=0.5)
+    p.add_argument("--size", type=int, default=1024 * 1024)
+    p.add_argument("--make-bucket", action="store_true")
+    args = p.parse_args()
+    if args.make_bucket:
+        from minio_tpu.s3.client import S3Client
+        S3Client(args.host, args.port, args.access_key,
+                 args.secret_key).make_bucket(args.bucket)
+    report = run_load(args.host, args.port, args.access_key,
+                      args.secret_key, args.bucket,
+                      concurrency=args.concurrency,
+                      duration=args.duration, qps=args.qps,
+                      put_fraction=args.put_fraction,
+                      object_bytes=args.size)
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

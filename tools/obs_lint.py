@@ -1,7 +1,7 @@
 """Fast observability lint, wired into the tier-1 path
 (tests/test_observability.py runs main() and fails on any violation).
 
-Two invariants, both cheap AST walks:
+Three invariants, all cheap AST walks:
 
 1. No bare ``assert`` used for error handling in ``minio_tpu/native/``:
    a ``python -O`` run strips asserts, which would let a garbled native
@@ -13,6 +13,12 @@ Two invariants, both cheap AST walks:
    ``minio_tpu/obs/metrics2.py`` — the namespace the node AND cluster
    endpoints render must not drift (the registry also raises at
    runtime; this catches dead/typoed names before they ever record).
+
+3. Every metric RECORDING call in ``minio_tpu/qos/`` (METRICS2.inc /
+   observe / set_gauge) must pass a literal, registered name: the QoS
+   layer's shed/wait/lane numbers are the acceptance evidence for
+   brownout behavior, so a dynamically-built (unlintable) or typoed
+   name there is a lint failure, not a runtime surprise.
 
 Run standalone: ``python -m tools.obs_lint``.
 """
@@ -88,10 +94,45 @@ def check_metric_names() -> list[str]:
     return violations
 
 
+def check_qos_metric_calls() -> list[str]:
+    """Recording calls in minio_tpu/qos/ must use literal registered
+    names (rule 2 only sees string literals — a name built at runtime
+    would slip past it; here the CALL itself is the unit checked)."""
+    from minio_tpu.obs.metrics2 import METRICS2
+    registered = METRICS2.registered_names()
+    recorders = {"inc", "observe", "set_gauge"}
+    violations = []
+    for path in _py_files(os.path.join(PKG, "qos")):
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in recorders
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "METRICS2"):
+                continue
+            rel = os.path.relpath(path, REPO)
+            if not node.args or not (
+                    isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                violations.append(
+                    f"{rel}:{node.lineno}: qos metric call must pass a "
+                    "literal metric name (dynamic names are unlintable)")
+                continue
+            name = node.args[0].value
+            if name not in registered:
+                violations.append(
+                    f"{rel}:{node.lineno}: qos metric {name!r} is not "
+                    "registered in minio_tpu/obs/metrics2.py")
+    return violations
+
+
 def main() -> int:
     if REPO not in sys.path:
         sys.path.insert(0, REPO)
-    violations = check_native_asserts() + check_metric_names()
+    violations = (check_native_asserts() + check_metric_names()
+                  + check_qos_metric_calls())
     for v in violations:
         print(v)
     if violations:
