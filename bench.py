@@ -390,7 +390,11 @@ def bench_heal(np, workdir: str, device: bool = False) -> dict:
     disks = [XLStorage(r) for r in roots]
     eng = ErasureObjects(disks, 16, 4, block_size=1024 * 1024)
     eng.make_bucket("bench")
-    n_objects, obj_bytes = 24, 8 * 1024 * 1024  # 192 MiB (scaled from
+    # 2x96MiB (was 24x8MiB): same 192MiB total, but objects larger than
+    # one HEAL_BATCH_BYTES group so the heal pipeline (reconstruct
+    # overlapping write-back) actually engages — the shape the BASELINE
+    # 1000x64MiB workload has.
+    n_objects, obj_bytes = 2, 96 * 1024 * 1024  # 192 MiB (scaled from
     rng = np.random.default_rng(5)              # 1000x64MiB; wall-time bound)
     try:
         for i in range(n_objects):
@@ -417,7 +421,7 @@ def bench_heal(np, workdir: str, device: bool = False) -> dict:
         return {"metric": "ec16+4_heal",
                 "value": round(total / dt / (1 << 30), 3), "unit": "GiB/s",
                 "objects_healed": healed, "total_bytes": total,
-                "scale": "24x8MiB stand-in for BASELINE's 1000x64MiB",
+                "scale": "2x96MiB stand-in for BASELINE's 1000x64MiB",
                 "tpu_dispatches": after["tpu_dispatches"]
                 - before["tpu_dispatches"]}
     finally:
@@ -552,14 +556,17 @@ class _DeviceHunt(threading.Thread):
         self.device_seen = False
         self.last_error = ""
         self.probes = 0
-        self._stop = threading.Event()
+        # Named _halt, not _stop: threading.Thread has a private
+        # _stop() METHOD that join() calls internally; shadowing it
+        # with an Event makes join() raise once the thread finishes.
+        self._halt = threading.Event()
 
     def run(self) -> None:
         from tools import device_watch as dw
-        while not self._stop.is_set():
+        while not self._halt.is_set():
             self.probes += 1
             ok, err = dw.probe()
-            if self._stop.is_set():
+            if self._halt.is_set():
                 return
             if not ok:
                 self.last_error = f"device-probe: {err}"
@@ -568,7 +575,7 @@ class _DeviceHunt(threading.Thread):
                 # Probes run niced (device_watch.probe), but even so:
                 # a hung relay means ~150s per attempt, so within one
                 # bench window few retries are possible anyway.
-                self._stop.wait(120)
+                self._halt.wait(120)
                 continue
             self.device_seen = True
             _progress("device up; running device bench subprocess")
@@ -582,10 +589,10 @@ class _DeviceHunt(threading.Thread):
                     pass
                 return
             self.last_error = f"device-bench: {res.get('error')}"
-            self._stop.wait(30)
+            self._halt.wait(30)
 
     def stop(self) -> None:
-        self._stop.set()
+        self._halt.set()
 
 
 def main() -> None:
@@ -642,6 +649,15 @@ def main() -> None:
         dir="/dev/shm" if os.path.isdir("/dev/shm") else None)
     out["workdir"] = ("tmpfs" if workdir.startswith("/dev/shm")
                       else "disk")
+    # Which data-plane pipeline (utils/pipeline.py PIPE_STATS name) each
+    # config exercises; its overlap factor (stage busy seconds / wall
+    # seconds, > 1.0 = stages genuinely overlapped) is attached to the
+    # config record so BENCH_r0N.json files track pipelining
+    # regressions. put_p50's 1MiB objects fit one encode batch, so its
+    # pipeline never engages and no factor is reported there.
+    from minio_tpu.utils.pipeline import PIPE_STATS, PipelineStats
+    config_pipeline = {"put_p50": "put", "multipart": "put",
+                       "get_2lost": "get", "heal": "heal"}
     configs: list[dict] = []
     for name, fn in (("put_p50", lambda: bench_put_p50(np, workdir)),
                      ("encode_verify",
@@ -653,9 +669,26 @@ def main() -> None:
                      ("qos_brownout",
                       lambda: bench_qos_brownout(np, workdir))):
         _progress(f"config {name} (host mode)")
-        res, err = _retrying(fn, name, attempts=2, base_sleep=1.0)
+        pipe = config_pipeline.get(name)
+        factor_box: dict = {}
+
+        def run_measured(fn=fn, pipe=pipe, factor_box=factor_box):
+            # Snapshot per ATTEMPT: a failed first try's partial
+            # pipeline stats must not pollute the successful run's
+            # overlap factor.
+            before = PIPE_STATS.snapshot()
+            out = fn()
+            if pipe is not None:
+                factor_box["factor"] = PipelineStats.overlap_factor(
+                    before, PIPE_STATS.snapshot(), pipe)
+            return out
+
+        res, err = _retrying(run_measured, name, attempts=2,
+                             base_sleep=1.0)
         if res is not None:
             res["device_asserted"] = False
+            if factor_box.get("factor") is not None:
+                res["overlap_factor"] = round(factor_box["factor"], 3)
             configs.append(res)
         else:
             errors[name] = err or "unknown"

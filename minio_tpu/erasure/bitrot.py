@@ -78,6 +78,11 @@ def digest_chunks(algo: str, data: bytes, chunk_size: int) -> list[bytes]:
 # TPU_MIN_BYTES (erasure/codec.py).
 HH_TPU_MIN_BYTES = 4 * 1024 * 1024
 
+# Below this many coalesced bytes, host hashing stays on the calling
+# thread: a multi-thread fan-out of sub-millisecond native hash calls
+# costs more in scheduling than it saves in parallelism.
+HOST_HASH_FANOUT_MIN = 8 * 1024 * 1024
+
 
 def _device_hash_ok(algo: str, chunk_size: int, total_full_bytes: int,
                     ) -> bool:
@@ -173,9 +178,13 @@ def encode_stream_arrays(arrs, algo: str = DEFAULT_ALGORITHM):
     if per_shard_digs is None:
         # Host hashing: shards fan out on multicore (the native kernel
         # releases the GIL), sequential where a second core doesn't
-        # exist — same policy as _host_digest_many.
+        # exist — same policy as _host_digest_many. Small batches stay
+        # sequential even on multicore: dispatching k+m sub-millisecond
+        # hash jobs costs more in thread wakeups than the hashing
+        # itself (measured 3-20ms of scheduling noise for a 1MiB PUT
+        # batch vs 0.5ms hashed inline).
         from ..parallel.quorum import MULTICORE, parallel_map
-        if len(arrs) > 1 and MULTICORE:
+        if len(arrs) > 1 and MULTICORE and total >= HOST_HASH_FANOUT_MIN:
             per_shard_digs, errs = parallel_map(
                 [lambda a=a: digest_rows(algo, a) for a in arrs])
             if any(e is not None for e in errs):
@@ -192,13 +201,46 @@ def encode_stream_arrays(arrs, algo: str = DEFAULT_ALGORITHM):
     return out
 
 
+def frame_shard(full_rows, tail: bytes | None,
+                algo: str = DEFAULT_ALGORITHM) -> bytes:
+    """Frame ONE shard's batch contribution: `full_rows` is a
+    (n_blocks, shard_size) contiguous uint8 array (or None) of
+    full-block sub-blocks, `tail` the final short block's bytes (or
+    None). Byte-identical to this shard's slice of
+    ``encode_stream_arrays`` + the tail frame of ``encode_streams``
+    (pinned by tests/test_pipeline.py golden compare) — but callable
+    per shard from the writer fan-out, so the hash of shard j overlaps
+    the disk write of shard i on the pipelined PUT path."""
+    import numpy as np
+    if not is_streaming(algo):
+        parts = []
+        if full_rows is not None and full_rows.size:
+            parts.append(np.ascontiguousarray(full_rows)
+                         .reshape(-1).tobytes())
+        if tail:
+            parts.append(bytes(tail))
+        return b"".join(parts)
+    hsize = hash_size(algo)
+    parts = []
+    if full_rows is not None and full_rows.size:
+        B, S = full_rows.shape
+        frame = np.empty((B, hsize + S), dtype=np.uint8)
+        frame[:, :hsize] = digest_rows(algo, full_rows)
+        frame[:, hsize:] = full_rows
+        parts.append(frame.reshape(-1).tobytes())
+    if tail:
+        parts.append(digest(algo, tail) + tail)
+    return b"".join(parts)
+
+
 def _host_digest_many(algo: str, streams: list[bytes],
                       chunk_size: int) -> list[list[bytes]]:
     """Host path of digest_chunks_many: on multicore hosts the k+m
     shards hash in parallel — the native HighwayHash kernel releases
     the GIL, so the fan-out is real concurrency."""
     from ..parallel.quorum import MULTICORE, parallel_map
-    if len(streams) > 1 and MULTICORE:
+    if len(streams) > 1 and MULTICORE and \
+            sum(len(s) for s in streams) >= HOST_HASH_FANOUT_MIN:
         results, errs = parallel_map(
             [lambda s=s: digest_chunks(algo, s, chunk_size)
              for s in streams])
@@ -298,6 +340,18 @@ def verify_frames(datas: list, wants: list[bytes],
     device dispatch when the policy allows (the read-path entry for TPU
     bitrot — ref streamingBitrotReader verify-per-chunk,
     cmd/bitrot-streaming.go:115, lifted to a batch)."""
+    import numpy as np
+
+    def stack_group(idxs: list[int]):
+        return np.stack([
+            np.frombuffer(datas[i], dtype=np.uint8)
+            if not isinstance(datas[i], np.ndarray) else datas[i]
+            for i in idxs])
+
+    # Worth one (B, L) stack copy: enough same-length frames that a
+    # single rows dispatch beats a Python loop of per-frame calls
+    # (~2x on a degraded-GET read window's verify pass).
+    HOST_ROWS_MIN_FRAMES = 5
     by_len: dict[int, list[int]] = {}
     for i, d in enumerate(datas):
         by_len.setdefault(len(d), []).append(i)
@@ -305,16 +359,18 @@ def verify_frames(datas: list, wants: list[bytes],
     for length, idxs in by_len.items():
         total = length * len(idxs)
         if length and _device_hash_ok(algo, length, total):
-            import numpy as np
-            stacked = np.stack([
-                np.frombuffer(datas[i], dtype=np.uint8)
-                if not isinstance(datas[i], np.ndarray) else datas[i]
-                for i in idxs])
-            digs = _hash_rows_device(stacked, total, len(idxs))
+            digs = _hash_rows_device(stack_group(idxs), total,
+                                     len(idxs))
             if digs is not None:
                 for row, i in enumerate(idxs):
                     ok[i] = digs[row].tobytes() == wants[i]
                 continue
+        if length and len(idxs) >= HOST_ROWS_MIN_FRAMES and \
+                algo in (HIGHWAYHASH256, HIGHWAYHASH256S):
+            digs = digest_rows(algo, stack_group(idxs))
+            for row, i in enumerate(idxs):
+                ok[i] = digs[row].tobytes() == wants[i]
+            continue
         for i in idxs:
             d = datas[i]
             if not isinstance(d, (bytes, bytearray)):

@@ -148,11 +148,18 @@ class ErasureObjects:
         self.m = parity_shards
         self.block_size = block_size
         # Streaming-pipeline knobs: how many bytes one encode dispatch /
-        # one read window group covers. Peak data-plane memory is
-        # O(batch), independent of object size.
-        from ..utils.streams import DEFAULT_BATCH_BYTES
-        self.put_batch_bytes = DEFAULT_BATCH_BYTES
-        self.read_group_bytes = DEFAULT_BATCH_BYTES
+        # one read window group covers, and how many batches/groups may
+        # be in flight at once (utils/pipeline.py). Peak data-plane
+        # memory is O(pipeline_depth × batch), independent of object
+        # size. Batches are sized so a multi-batch stream actually
+        # pipelines (several batches per large part) while one encode
+        # dispatch still clears the device-batching threshold
+        # (codec.TPU_MIN_BYTES).
+        from ..utils.pipeline import DEFAULT_DEPTH
+        from ..utils.streams import DEFAULT_BATCH_BYTES, PUT_BATCH_BYTES
+        self.put_batch_bytes = PUT_BATCH_BYTES
+        self.read_group_bytes = DEFAULT_BATCH_BYTES // 2
+        self.pipeline_depth = DEFAULT_DEPTH
         self.codec = Erasure(data_shards, parity_shards, block_size)
         self._codec_cache: dict[tuple[int, int], Erasure] = {}
         from ..parallel.nslock import LocalNSLock
@@ -453,55 +460,24 @@ class ErasureObjects:
 
         from ..obs.span import TRACER
         from ..utils.phasetimer import PUT as _PUT
-        _t_enc = _t_wr = 0.0
+
+        def quorum_msg() -> str:
+            causes = "; ".join(
+                f"disk{i}: {type(e).__name__}: {e}"
+                for i, e in enumerate(disk_errs)
+                if e is not None)
+            return ("write quorum lost mid-stream "
+                    f"({sum(alive)}/{n}, need {wq}): {causes}")
+
         try:
             # Staging happens OUTSIDE the namespace lock: a slow
             # client-paced stream must not block readers of the key.
             # Only the commit below takes the write lock (ref NSLock
             # placement just before the metadata write + rename,
             # cmd/erasure-object.go:694-700).
-            for batch in streams.iter_batches(reader,
-                                              self.block_size,
-                                              self.put_batch_bytes):
-                _t0 = time.perf_counter()
-                with TRACER.span("ec.encode", bytes=len(batch)):
-                    # The etag md5 overlaps the erasure encode on
-                    # multicore hosts: both walk the same batch, md5
-                    # releases the GIL on big buffers, and stream order
-                    # is preserved because each batch joins before the
-                    # next submits (~1.7ms off a 1MiB PUT's critical
-                    # path).
-                    md5_fut = (submit(md5.update, batch)
-                               if md5 is not None and MULTICORE
-                               else None)
-                    if md5 is not None and md5_fut is None:
-                        md5.update(batch)
-                    total += len(batch)
-                    chunks = self._encode_batch(batch, k, m, codec)
-                    if md5_fut is not None:
-                        md5_fut.result()
-                _t1 = time.perf_counter()
-                _t_enc += _t1 - _t0
-                live = [i for i in range(n) if alive[i]]
-                with TRACER.span("ec.write", bytes=len(batch)) as _ws:
-                    _, errs = parallel_map(
-                        [lambda i=i: append_one(
-                            i, chunks[distribution[i] - 1], _ws)
-                         for i in live])
-                _t_wr += time.perf_counter() - _t1
-                for i, e in zip(live, errs):
-                    if e is not None:
-                        alive[i] = False
-                        disk_errs[i] = e
-                if sum(alive) < wq:
-                    causes = "; ".join(
-                        f"disk{i}: {type(e).__name__}: {e}"
-                        for i, e in enumerate(disk_errs)
-                        if e is not None)
-                    raise QuorumError(
-                        "write quorum lost mid-stream "
-                        f"({sum(alive)}/{n}, need {wq}): {causes}",
-                        [e for e in disk_errs if e is not None])
+            total, _t_enc, _t_wr = self._stream_shard_writes(
+                reader, k, m, codec, distribution, append_one,
+                alive, disk_errs, wq, quorum_msg, md5)
             # A hash-verifying reader raises here when the declared
             # md5/sha256/size doesn't match what streamed through —
             # the staged shards are discarded, nothing committed
@@ -589,6 +565,187 @@ class ErasureObjects:
                           version_id=version_id, metadata=meta,
                           parts=[part])
 
+    def _stream_shard_writes(self, reader, k: int, m: int, codec,
+                             distribution, append_shard, alive,
+                             disk_errs, wq: int, quorum_msg, md5,
+                             name: str = "put",
+                             ) -> tuple[int, float, float]:
+        """The pipelined PUT/part data plane (shared by put_object and
+        multipart.put_object_part): consume `reader` in encode batches;
+        while batch N's k+m shards fan out to disks, batch N+1 is
+        already being read from the client and erasure-encoded on the
+        pipeline's worker thread (utils/pipeline.py, bounded depth —
+        at most depth+1 encoded batches alive). Write quorum is
+        re-checked per batch at the join point, exactly as the serial
+        loop did. A single-batch stream (object <= put_batch_bytes)
+        never starts the worker: small PUTs stay thread-free.
+
+        append_shard(disk_index, payload, parent_span) performs one
+        shard append; alive/disk_errs are the caller's per-disk
+        degradation state (mutated in place); quorum_msg() renders the
+        caller's quorum-loss error text.
+
+        Returns (total_bytes, encode_seconds, write_seconds) — the two
+        phase sums overlap under the pipeline, so their total may
+        exceed wall time (that ratio is the bench's overlap factor).
+        """
+        from ..obs.span import TRACER
+        from ..utils import streams
+        from ..utils.pipeline import Prefetch
+        n = k + m
+        shard_size = codec.shard_size()
+        root = TRACER.current()
+        state = {"total": 0, "enc_s": 0.0, "wr_s": 0.0}
+
+        def encode_one(batch: bytes):
+            t0 = time.perf_counter()
+            with TRACER.span("ec.encode", parent=root,
+                             bytes=len(batch)):
+                # The etag md5 overlaps the erasure encode on multicore
+                # hosts: both walk the same batch, md5 releases the GIL
+                # on big buffers, and stream order is preserved because
+                # each batch joins before the next submits (~1.7ms off
+                # a 1MiB PUT's critical path).
+                md5_fut = (submit(md5.update, batch)
+                           if md5 is not None and MULTICORE else None)
+                if md5 is not None and md5_fut is None:
+                    md5.update(batch)
+                state["total"] += len(batch)
+                full_sm, tails = self._encode_batch_split(batch, k, m,
+                                                          codec)
+                framed = None
+                if full_sm is not None and bitrot._device_hash_ok(
+                        bitrot.DEFAULT_ALGORITHM, shard_size,
+                        full_sm.nbytes):
+                    # Device bitrot stays one coalesced dispatch over
+                    # all shards; per-shard hashing in the writer
+                    # fan-out would fragment it below the threshold.
+                    framed = self._frame_split(full_sm, tails, codec)
+                if md5_fut is not None:
+                    md5_fut.result()
+            state["enc_s"] += time.perf_counter() - t0
+            return len(batch), full_sm, tails, framed
+
+        def write_batch(item) -> None:
+            nbytes, full_sm, tails, framed = item
+            t1 = time.perf_counter()
+            live = [i for i in range(n) if alive[i]]
+            with TRACER.span("ec.write", bytes=nbytes) as _ws:
+                def one(i: int) -> None:
+                    j = distribution[i] - 1
+                    if framed is not None:
+                        payload = framed[j]
+                    else:
+                        # Host bitrot rides the writer fan-out: the
+                        # hash of shard j (GIL-released native kernel)
+                        # overlaps the disk writes of the other shards.
+                        payload = bitrot.frame_shard(
+                            None if full_sm is None else full_sm[j],
+                            None if tails is None else tails[j])
+                    append_shard(i, payload, _ws)
+                _, errs = parallel_map(
+                    [lambda i=i: one(i) for i in live])
+            state["wr_s"] += time.perf_counter() - t1
+            for i, e in zip(live, errs):
+                if e is not None:
+                    alive[i] = False
+                    disk_errs[i] = e
+            if sum(alive) < wq:
+                raise QuorumError(
+                    quorum_msg(),
+                    [e for e in disk_errs if e is not None])
+
+        per = streams.batch_size(self.block_size, self.put_batch_bytes)
+        first = streams.read_exactly(reader, per)
+        if not first:
+            return 0, 0.0, 0.0
+        # One-byte lookahead: a stream of EXACTLY one full batch must
+        # also take the inline path — without it, an 8MiB part would
+        # spin up the worker for a single item. The probe blocks no
+        # longer than the next batch read would have.
+        probe = b"" if len(first) < per else streams.read_exactly(
+            reader, 1)
+        if len(first) < per or not probe:
+            # The whole stream fit in one batch: encode + write inline
+            # on the request thread (no worker, no queue — a small PUT
+            # must not pay a thread handoff for nothing to overlap).
+            write_batch(encode_one(first))
+            return state["total"], state["enc_s"], state["wr_s"]
+        batches = streams.iter_batches(
+            streams.PushbackReader(probe, reader), self.block_size,
+            self.put_batch_bytes)
+
+        def produce():
+            yield encode_one(first)
+            for batch in batches:
+                yield encode_one(batch)
+
+        with Prefetch(produce(), depth=self.pipeline_depth,
+                      name=name, span=root) as pf:
+            for item in pf:
+                write_batch(item)
+        return state["total"], state["enc_s"], state["wr_s"]
+
+    def _encode_batch_split(self, data: bytes, k: int, m: int, codec,
+                            ) -> tuple:
+        """RS-encode one batch WITHOUT bitrot framing: returns
+        (full_sm, tails) where full_sm is a shard-major
+        (k+m, n_blocks, shard_size) uint8 array of the full blocks'
+        shards (None when the batch is shorter than one block) and
+        tails the k+m per-shard byte strings of the final short block
+        (None when the batch is block-aligned). Framing happens either
+        centrally (_frame_split — the device-hash path) or per shard
+        in the writer fan-out (bitrot.frame_shard)."""
+        n = k + m
+        if len(data) == 0:
+            return None, None
+        from ..obs.span import TRACER
+        with TRACER.span("kernel.rs_encode", bytes=len(data),
+                         k=k, m=m):
+            shard_size = codec.shard_size()
+            full_sm = None
+            nfull = len(data) // self.block_size
+            if nfull:
+                # Each block is zero-padded to k*shard_size (split
+                # padding semantics, ref dependency Split of
+                # cmd/erasure-coding.go:74).
+                full = np.frombuffer(
+                    data[:nfull * self.block_size], dtype=np.uint8,
+                ).reshape(nfull, self.block_size)
+                if self.block_size != k * shard_size:
+                    padded = np.zeros((nfull, k * shard_size),
+                                      dtype=np.uint8)
+                    padded[:, :self.block_size] = full
+                    full = padded
+                full = full.reshape(nfull, k, shard_size)
+                # Shard-major framing: each full block is exactly one
+                # bitrot sub-block, so (n_blocks, S) rows frame
+                # directly — no per-shard byte reassembly.
+                full_sm = codec.encode_blocks_batch_shardmajor(full)
+            rest = data[nfull * self.block_size:]
+            tails = None
+            if rest:
+                shards = codec.encode_data(rest)
+                tails = [shards[j].tobytes() for j in range(n)]
+            return full_sm, tails
+
+    def _frame_split(self, full_sm, tails, codec) -> list:
+        """Bitrot-frame a split-encoded batch into per-shard chunks —
+        byte-identical to the pre-split _encode_batch output (golden
+        tests): consecutive batches concatenate into a valid
+        streaming-bitrot shard file (ref cmd/bitrot-streaming.go:46)."""
+        shard_size = codec.shard_size()
+        full_frames = None
+        if full_sm is not None:
+            full_frames = bitrot.encode_stream_arrays(list(full_sm))
+        if tails is None:
+            return full_frames
+        tail_frames = bitrot.encode_streams(tails, shard_size)
+        if full_frames is None:
+            return tail_frames
+        return [np.concatenate([ff, np.frombuffer(tf, np.uint8)])
+                for ff, tf in zip(full_frames, tail_frames)]
+
     def _encode_batch(self, data: bytes, k: int | None = None,
                       m: int | None = None,
                       codec=None) -> list[bytes]:
@@ -605,50 +762,11 @@ class ErasureObjects:
         n = k + m
         if len(data) == 0:
             return [b""] * n
-        # Kernel child span: the RS+bitrot math of this batch as seen
-        # from the request (includes any coalescer window wait); which
-        # device actually ran it is in the kernel counters
-        # (obs/kernel_stats.py).
-        from ..obs.span import TRACER
-        with TRACER.span("kernel.rs_encode", bytes=len(data),
-                         k=k, m=m):
-            return self._encode_batch_inner(data, k, m, codec)
-
-    def _encode_batch_inner(self, data: bytes, k: int, m: int,
-                            codec) -> list[bytes]:
-        n = k + m
-        shard_size = codec.shard_size()
-
-        full_frames = None
-        nfull = len(data) // self.block_size
-        if nfull:
-            # Each block is zero-padded to k*shard_size (split padding
-            # semantics, ref dependency Split of cmd/erasure-coding.go:74).
-            full = np.frombuffer(
-                data[:nfull * self.block_size], dtype=np.uint8,
-            ).reshape(nfull, self.block_size)
-            if self.block_size != k * shard_size:
-                padded = np.zeros((nfull, k * shard_size),
-                                  dtype=np.uint8)
-                padded[:, :self.block_size] = full
-                full = padded
-            full = full.reshape(nfull, k, shard_size)
-            # Shard-major framing: each full block is exactly one
-            # bitrot sub-block, so (n_blocks, S) rows frame directly —
-            # no per-shard byte reassembly (this copy-count cut
-            # roughly doubled host multipart encode throughput).
-            sm = codec.encode_blocks_batch_shardmajor(full)
-            full_frames = bitrot.encode_stream_arrays(list(sm))
-        rest = data[nfull * self.block_size:]
-        if not rest:
-            return full_frames
-        shards = codec.encode_data(rest)
-        tail_frames = bitrot.encode_streams(
-            [shards[j].tobytes() for j in range(n)], shard_size)
-        if full_frames is None:
-            return tail_frames
-        return [np.concatenate([ff, np.frombuffer(tf, np.uint8)])
-                for ff, tf in zip(full_frames, tail_frames)]
+        # The kernel child span (RS math + any coalescer window wait)
+        # opens inside _encode_batch_split; which device actually ran
+        # it is in the kernel counters (obs/kernel_stats.py).
+        full_sm, tails = self._encode_batch_split(data, k, m, codec)
+        return self._frame_split(full_sm, tails, codec)
 
     def _encode_object(self, data: bytes, k: int | None = None,
                        m: int | None = None,
@@ -866,59 +984,77 @@ class ErasureObjects:
         want_end = offset + length
 
         from ..obs.span import TRACER
-        for g0 in range(start_block, end_block + 1, group):
+        # Captured ONCE on the consumer's thread: both the pipeline's
+        # prefetch worker and parallel_map fetch workers attach their
+        # shard-read spans to it (the contextvar doesn't cross threads).
+        _read_parent = TRACER.current()
+
+        def fetch(j: int, win_off: int, n_cov: int,
+                  windows: dict) -> bool:
+            """Fetch shard j's window for one group; False if
+            unavailable."""
+            if j in windows:
+                return True
+            if j in failed or by_shard[j] is None:
+                return False
+            disk = self.disks[by_shard[j]]
+            f = agreed[by_shard[j]]
+            try:
+                if _read_parent is None:
+                    windows[j] = disk.read_file(
+                        fi.volume,
+                        f"{fi.name}/{f.data_dir}/part.{part_number}",
+                        win_off, n_cov * stride)
+                    return True
+                with TRACER.span("ec.shard_read", parent=_read_parent,
+                                 shard=j, endpoint=str(disk),
+                                 bytes=n_cov * stride):
+                    windows[j] = disk.read_file(
+                        fi.volume,
+                        f"{fi.name}/{f.data_dir}/part.{part_number}",
+                        win_off, n_cov * stride)
+                return True
+            except Exception:
+                failed.add(j)
+                return False
+
+        def fetch_group(g0: int) -> tuple:
+            """Stage 1 (pipeline producer): pull one group's shard
+            windows — first-k-wins, then CONCURRENT parity fallback
+            bounded by how many shards are still missing, so a 2-lost
+            read pays one extra read RTT instead of two sequential
+            ones (ref parallelReader, cmd/erasure-decode.go:104)."""
             g1 = min(g0 + group - 1, end_block)
             n_cov = g1 - g0 + 1
             win_off = g0 * stride
             windows: dict[int, bytes] = {}
-            # Captured in the CONSUMER's thread each group: parallel
-            # fetch workers attach their shard-read spans to it (the
-            # contextvar doesn't cross into parallel_map threads).
-            _read_parent = TRACER.current()
-
-            def fetch(j: int, _parent=_read_parent) -> bool:
-                """Fetch shard j's window for this group; False if
-                unavailable."""
-                if j in windows:
-                    return True
-                if j in failed or by_shard[j] is None:
-                    return False
-                disk = self.disks[by_shard[j]]
-                f = agreed[by_shard[j]]
-                try:
-                    if _parent is None:
-                        windows[j] = disk.read_file(
-                            fi.volume,
-                            f"{fi.name}/{f.data_dir}/part.{part_number}",
-                            win_off, n_cov * stride)
-                        return True
-                    with TRACER.span("ec.shard_read", parent=_parent,
-                                     shard=j, endpoint=str(disk),
-                                     bytes=n_cov * stride):
-                        windows[j] = disk.read_file(
-                            fi.volume,
-                            f"{fi.name}/{f.data_dir}/part.{part_number}",
-                            win_off, n_cov * stride)
-                    return True
-                except Exception:
-                    failed.add(j)
-                    return False
-
-            # First-k-wins: fire the k data-shard reads in parallel,
-            # fall back to parity serially (ref parallelReader,
-            # cmd/erasure-decode.go:104).
-            parallel_map([lambda j=j: fetch(j) for j in range(k)])
+            parallel_map([lambda j=j: fetch(j, win_off, n_cov, windows)
+                          for j in range(k)])
             have = [j for j in candidates if j in windows]
-            for j in candidates:
-                if len(have) >= k:
-                    break
-                if j not in have and fetch(j):
-                    have.append(j)
+            # Known-dead shards (condemned in an earlier group, or
+            # with no mapped disk) would burn the first burst's slots
+            # on instant-False fetches — the burst must hold real
+            # parity reads.
+            rest = [j for j in candidates
+                    if j not in windows and j not in failed
+                    and by_shard[j] is not None]
+            while len(have) < k and rest:
+                burst = rest[:k - len(have)]
+                rest = rest[len(burst):]
+                oks, _ = parallel_map(
+                    [lambda j=j: fetch(j, win_off, n_cov, windows)
+                     for j in burst])
+                have.extend(j for j, ok in zip(burst, oks) if ok)
             if len(have) < k:
                 raise QuorumError(
                     f"read quorum not met: only {len(have)}/{k} "
                     "shards readable", [])
+            return g0, g1, n_cov, win_off, windows, have
 
+        def decode_group(item):
+            """Stage 2 (consumer): verify, reconstruct, and trim one
+            fetched group; yields the plaintext chunks in range order."""
+            g0, g1, n_cov, win_off, windows, have = item
             # Pass 1: gather + bitrot-verify every block's chunk in this
             # group (views into the fetched windows, no copies). All
             # frames of all fetched windows verify in ONE batched call —
@@ -989,7 +1125,8 @@ class ErasureObjects:
             for j in candidates:
                 if len(verified) >= k:
                     break
-                if j not in verified and fetch(j):
+                if j not in verified and fetch(j, win_off, n_cov,
+                                               windows):
                     verify_window([j])
 
             # (A vectorized group-gather fast path was tried here and
@@ -1033,6 +1170,33 @@ class ErasureObjects:
                 hi = min(want_end, bstart + blk_len) - bstart
                 if hi > lo:
                     yield block_data[lo:hi]
+
+        group_starts = range(start_block, end_block + 1, group)
+        if len(group_starts) <= 1:
+            # Single group: no read-ahead to do — stay thread-free.
+            for g0 in group_starts:
+                yield from decode_group(fetch_group(g0))
+            return
+
+        # Read-ahead pipeline: group g+1's shard windows are fetched on
+        # the worker while group g verifies, reconstructs, and yields to
+        # the client (utils/pipeline.py; bounded depth keeps memory at
+        # O(depth × group)). The shared `failed` set stays coherent: a
+        # shard condemned by verification in group g is skipped by every
+        # LATER fetch, and a window already in flight for it still
+        # passes through the same verify pass before use. Abandoning the
+        # stream (GeneratorExit at a yield) closes the pipeline, which
+        # stops and joins the worker.
+        from ..utils.pipeline import Prefetch
+
+        def produce():
+            for g0 in group_starts:
+                yield fetch_group(g0)
+
+        with Prefetch(produce(), depth=self.pipeline_depth, name="get",
+                      span=_read_parent) as pf:
+            for item in pf:
+                yield from decode_group(item)
 
     # ------------------------------------------------------------------
     # delete / list

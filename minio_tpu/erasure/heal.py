@@ -16,6 +16,7 @@ stage 5; one mask group per part, tail block forming its own group).
 
 from __future__ import annotations
 
+import contextlib
 import queue
 import threading
 import time
@@ -225,82 +226,152 @@ class Healer:
 
         # Rebuild every part's full shard matrix blockwise from k good
         # shards: one decode per block, shared mask across the whole
-        # object (the best TPU batch source).
+        # object (the best TPU batch source). The rebuild STREAMS
+        # through a bounded pipeline (utils/pipeline.py): the producer
+        # reads survivors, batch-reconstructs one block group, and
+        # bitrot-frames it, while the consumer writes the PREVIOUS
+        # group's regenerated frames to the bad disks — reconstruct
+        # dispatches overlap write-back I/O. The pipeline inherits the
+        # heal's background lane, so a deferred kernel dispatch stalls
+        # production and the queue drains (defer = drain, don't grow).
         shard_size = fi.erasure.shard_size()
         missing_shards = sorted(shard_of_disk[i] for i in bad)
         codec = Erasure(k, m, fi.erasure.block_size)
         from ..storage.metadata import ObjectPartInfo
         parts = fi.parts or [ObjectPartInfo(number=1, size=fi.size,
                                             actual_size=fi.size)]
-        # rebuilt[part_number][shard_idx] -> bytes
-        rebuilt: dict[int, dict[int, bytearray]] = {}
-        for part in parts:
-            # Collect k survivor streams, tolerating read failures from
-            # disks that were "ok" at classify time but dropped since
-            # (a peer restarting mid-sweep): any k good shards decode;
-            # only fewer than k is fatal for this object.
-            streams = {}
-            for i in good_disks:
-                if len(streams) == k:
-                    break
-                try:
-                    streams[shard_of_disk[i]] = eng.disks[i].read_all(
-                        bucket,
-                        f"{object_name}/{fi.data_dir}/part.{part.number}")
-                except serr.StorageError:
-                    continue
-            if len(streams) < k:
-                raise serr.FaultyDisk(
-                    f"heal {bucket}/{object_name}: only "
-                    f"{len(streams)}/{k} survivor shards readable")
+
+        def part_algo(part) -> str:
             algo = bitrot.DEFAULT_ALGORITHM
             for cs in fi.erasure.checksums:
                 if cs.get("part") == part.number:
                     algo = cs.get("algorithm", algo)
-            n_blocks = ceil_frac(part.size, fi.erasure.block_size)
-            acc = {j: bytearray() for j in missing_shards}
-            # All blocks share one erasure mask -> coalesced device
-            # dispatches (ops/batching.py), bounded to HEAL_BATCH_BYTES
-            # of stacked survivors so peak memory stays O(batch), not
-            # O(part).
-            group = max(1, HEAL_BATCH_BYTES // max(fi.erasure.block_size,
-                                                   1))
-            for b0 in range(0, n_blocks, group):
-                block_shards: list[list[np.ndarray | None]] = []
-                for b in range(b0, min(b0 + group, n_blocks)):
-                    blk_len = min(fi.erasure.block_size,
-                                  part.size - b * fi.erasure.block_size)
-                    chunk = ceil_frac(blk_len, k)
-                    shards: list[np.ndarray | None] = [None] * (k + m)
-                    for j, stream in streams.items():
-                        data = bitrot.extract_block(stream, b, chunk,
-                                                    shard_size, algo)
-                        shards[j] = np.frombuffer(data, dtype=np.uint8)
-                    block_shards.append(shards)
-                for full in codec.decode_all_blocks_batch(block_shards):
-                    for j in missing_shards:
-                        acc[j] += full[j].tobytes()
-            rebuilt[part.number] = acc
+            return algo
 
-        # Write regenerated shards to the bad disks (tmp -> rename_data,
-        # same commit path as PUT; ref Erasure.Heal writes via bitrot
-        # writers then writeUniqueFileInfo + rename).
-        def heal_one(i: int):
+        def produce_groups():
+            """Yield (part_number, {shard_idx: framed bytes}) per block
+            group, parts in order, groups in order — consecutive
+            groups' frames concatenate into exactly the shard stream
+            the old whole-part encode produced."""
+            for part in parts:
+                # Collect k survivor streams, tolerating read failures
+                # from disks that were "ok" at classify time but
+                # dropped since (a peer restarting mid-sweep): any k
+                # good shards decode; only fewer than k is fatal.
+                streams = {}
+                for i in good_disks:
+                    if len(streams) == k:
+                        break
+                    try:
+                        streams[shard_of_disk[i]] = \
+                            eng.disks[i].read_all(
+                                bucket,
+                                f"{object_name}/{fi.data_dir}"
+                                f"/part.{part.number}")
+                    except serr.StorageError:
+                        continue
+                if len(streams) < k:
+                    raise serr.FaultyDisk(
+                        f"heal {bucket}/{object_name}: only "
+                        f"{len(streams)}/{k} survivor shards readable")
+                algo = part_algo(part)
+                n_blocks = ceil_frac(part.size, fi.erasure.block_size)
+                if n_blocks == 0:
+                    # Zero-byte part: the (empty) shard file must still
+                    # exist on the healed disk.
+                    yield part.number, {j: b"" for j in missing_shards}
+                    continue
+                # All blocks share one erasure mask -> coalesced device
+                # dispatches (ops/batching.py), bounded to
+                # HEAL_BATCH_BYTES of stacked survivors so peak memory
+                # stays O(batch), not O(part).
+                group = max(1, HEAL_BATCH_BYTES
+                            // max(fi.erasure.block_size, 1))
+                for b0 in range(0, n_blocks, group):
+                    block_shards: list[list[np.ndarray | None]] = []
+                    for b in range(b0, min(b0 + group, n_blocks)):
+                        blk_len = min(
+                            fi.erasure.block_size,
+                            part.size - b * fi.erasure.block_size)
+                        chunk = ceil_frac(blk_len, k)
+                        shards: list[np.ndarray | None] = \
+                            [None] * (k + m)
+                        for j, stream in streams.items():
+                            data = bitrot.extract_block(
+                                stream, b, chunk, shard_size, algo)
+                            shards[j] = np.frombuffer(data,
+                                                      dtype=np.uint8)
+                        block_shards.append(shards)
+                    acc = {j: bytearray() for j in missing_shards}
+                    for full in codec.decode_all_blocks_batch(
+                            block_shards):
+                        for j in missing_shards:
+                            acc[j] += full[j].tobytes()
+                    # Group lengths are multiples of shard_size except
+                    # the part's final group, so per-group framing
+                    # concatenates byte-identically to whole-part
+                    # framing (pinned by tests/test_pipeline.py).
+                    yield part.number, {
+                        j: bitrot.encode_stream(bytes(acc[j]),
+                                                shard_size, algo)
+                        for j in missing_shards}
+
+        # Write regenerated shards to the bad disks group by group
+        # (tmp append stream -> rename_data, same commit path as PUT;
+        # ref Erasure.Heal writes via bitrot writers then
+        # writeUniqueFileInfo + rename). Per-disk failures drop that
+        # disk from the write set without aborting the others.
+        tmp_paths = {i: f"{TMP_PATH}/{uuid.uuid4()}" for i in bad}
+        write_errs: dict[int, BaseException] = {}
+
+        def drop_disk(i: int, exc: BaseException) -> None:
+            write_errs[i] = exc
+            try:
+                eng.disks[i].delete(MINIO_META_BUCKET, tmp_paths[i],
+                                    recursive=True)
+            except Exception:
+                pass
+
+        # A single-group object (the common small-object sweep case)
+        # has nothing to overlap: consume the generator inline rather
+        # than paying a worker-thread handoff per healed object.
+        group_blocks = max(1, HEAL_BATCH_BYTES
+                           // max(fi.erasure.block_size, 1))
+        n_groups = sum(
+            max(1, ceil_frac(ceil_frac(p.size, fi.erasure.block_size),
+                             group_blocks))
+            for p in parts)
+        from ..utils.pipeline import Prefetch
+        pf = (Prefetch(produce_groups(), depth=eng.pipeline_depth,
+                       name="heal")
+              if n_groups > 1 else
+              contextlib.nullcontext(produce_groups()))
+        with pf as groups:
+            try:
+                for part_number, frames in groups:
+                    live = [i for i in bad if i not in write_errs]
+                    if not live:
+                        break  # nobody left to heal; stop decoding
+                    _, errs = parallel_map(
+                        [lambda i=i: eng.disks[i].append_file(
+                            MINIO_META_BUCKET,
+                            f"{tmp_paths[i]}/{fi.data_dir}"
+                            f"/part.{part_number}",
+                            frames[shard_of_disk[i]])
+                         for i in live])
+                    for i, e in zip(live, errs):
+                        if e is not None:
+                            drop_disk(i, e)
+            except BaseException:
+                for i in bad:
+                    if i not in write_errs:
+                        drop_disk(i, serr.FaultyDisk("heal aborted"))
+                raise
+
+        def commit_one(i: int):
             disk = eng.disks[i]
             j = shard_of_disk[i]
-            tmp_path = f"{TMP_PATH}/{uuid.uuid4()}"
             try:
-                for part in parts:
-                    algo = bitrot.DEFAULT_ALGORITHM
-                    for cs in fi.erasure.checksums:
-                        if cs.get("part") == part.number:
-                            algo = cs.get("algorithm", algo)
-                    stream = bitrot.encode_stream(
-                        bytes(rebuilt[part.number][j]), shard_size, algo)
-                    disk.create_file(
-                        MINIO_META_BUCKET,
-                        f"{tmp_path}/{fi.data_dir}/part.{part.number}",
-                        stream)
                 new_fi = FileInfo(
                     volume=bucket, name=object_name,
                     version_id=fi.version_id, data_dir=fi.data_dir,
@@ -313,18 +384,21 @@ class Healer:
                         index=j + 1, distribution=list(dist),
                         checksums=list(fi.erasure.checksums)),
                 )
-                disk.rename_data(MINIO_META_BUCKET, tmp_path, new_fi,
-                                 bucket, object_name)
+                disk.rename_data(MINIO_META_BUCKET, tmp_paths[i],
+                                 new_fi, bucket, object_name)
             except BaseException:
                 try:
-                    disk.delete(MINIO_META_BUCKET, tmp_path,
+                    disk.delete(MINIO_META_BUCKET, tmp_paths[i],
                                 recursive=True)
                 except Exception:
                     pass
                 raise
 
-        _, errs = parallel_map([lambda i=i: heal_one(i) for i in bad])
-        res.healed_disks = [i for i, e in zip(bad, errs) if e is None]
+        alive_bad = [i for i in bad if i not in write_errs]
+        _, errs = parallel_map([lambda i=i: commit_one(i)
+                                for i in alive_bad])
+        res.healed_disks = [i for i, e in zip(alive_bad, errs)
+                            if e is None]
         res.after_ok = res.before_ok + len(res.healed_disks)
         return res
 

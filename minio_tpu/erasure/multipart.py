@@ -18,8 +18,9 @@ import hashlib
 import json
 import uuid
 
-from ..parallel.quorum import (QuorumError, hash_order, parallel_map,
-                               reduce_quorum_errs, write_quorum)
+from ..parallel.quorum import (QuorumError, first_success, hash_order,
+                               parallel_map, reduce_quorum_errs,
+                               write_quorum)
 from ..storage import errors as serr
 from ..storage.metadata import (ErasureInfo, FileInfo, ObjectPartInfo,
                                 new_data_dir, now)
@@ -84,15 +85,26 @@ class MultipartUploads:
 
     def _load_upload(self, bucket: str, object_name: str,
                      upload_id: str) -> dict:
+        """First-SUCCESS parallel probe for the upload record: all
+        disks are asked at once and the first healthy answer wins —
+        the old serial try/except walk paid a slow or dead disk's full
+        timeout on EVERY part upload before the next disk was even
+        asked, and a join-all fan-out would still wait for the
+        slowest. Under pool saturation first_success degrades to the
+        serial early-exit walk (never run-all); the n-1 discarded
+        straggler reads are a few hundred bytes each, noise next to
+        the n shard-append RPCs every part batch already fans out. A
+        torn record (ValueError) propagates, as before."""
         base = _upload_base(bucket, object_name, upload_id)
-        for disk in self.engine.disks:
-            try:
-                return json.loads(
-                    disk.read_all(MINIO_META_BUCKET,
-                                  f"{base}/upload.json"))
-            except serr.StorageError:
-                continue
-        raise UploadNotFound(upload_id)
+        try:
+            raw = first_success(
+                [lambda d=d: d.read_all(MINIO_META_BUCKET,
+                                        f"{base}/upload.json")
+                 for d in self.engine.disks],
+                swallow=serr.StorageError)
+        except QuorumError:
+            raise UploadNotFound(upload_id) from None
+        return json.loads(raw)
 
     def get_upload_meta(self, bucket: str, object_name: str,
                         upload_id: str) -> dict:
@@ -107,11 +119,16 @@ class MultipartUploads:
                         upload_id: str, part_number: int,
                         data,
                         actual_size: int | None = None) -> dict:
-        """Streaming part write — same batch pipeline as a single PUT
-        (ref PutObjectPart block loop, cmd/erasure-multipart.go:342):
-        `data` is bytes or a chunk reader; memory stays O(batch).
-        actual_size: pre-transform (plaintext/uncompressed) length when
-        the handler encrypted or compressed the part body."""
+        """Streaming part write — the same pipelined data plane as a
+        single PUT (engine._stream_shard_writes): batch N+1 is read and
+        erasure-encoded (with the etag md5 overlapped) while batch N's
+        shards fan out to disks, with the ec.encode / ec.write /
+        ec.shard_write tracing spans PutObject already had (ref
+        PutObjectPart block loop, cmd/erasure-multipart.go:342).
+        `data` is bytes or a chunk reader; memory stays
+        O(pipeline_depth × batch). actual_size: pre-transform
+        (plaintext/uncompressed) length when the handler encrypted or
+        compressed the part body."""
         from ..utils import streams
         eng = self.engine
         if not 1 <= part_number <= 10000:
@@ -124,7 +141,6 @@ class MultipartUploads:
         wq = write_quorum(eng.k, eng.m)
         stage = f"{base}/part.{part_number}.{uuid.uuid4().hex}.stage"
         md5 = None if hasattr(reader, "etag") else hashlib.md5()
-        total = 0
         alive = [True] * n
         disk_errs: list = [None] * n
 
@@ -133,26 +149,25 @@ class MultipartUploads:
                 lambda i=i: eng.disks[i].delete(MINIO_META_BUCKET, stage)
                 for i in indices])
 
+        def append_shard(i: int, payload, parent=None):
+            if parent is None:  # untraced fast path
+                eng.disks[i].append_file(MINIO_META_BUCKET, stage,
+                                         payload)
+                return
+            from ..obs.span import TRACER
+            with TRACER.span("ec.shard_write", parent=parent, disk=i,
+                             endpoint=str(eng.disks[i]),
+                             bytes=len(payload)):
+                eng.disks[i].append_file(MINIO_META_BUCKET, stage,
+                                         payload)
+
+        def quorum_msg() -> str:
+            return f"part write quorum lost ({sum(alive)}/{n})"
+
         try:
-            for batch in streams.iter_batches(reader, eng.block_size,
-                                              eng.put_batch_bytes):
-                if md5 is not None:
-                    md5.update(batch)
-                total += len(batch)
-                chunks = eng._encode_batch(batch)
-                live = [i for i in range(n) if alive[i]]
-                _, errs = parallel_map(
-                    [lambda i=i: eng.disks[i].append_file(
-                        MINIO_META_BUCKET, stage, chunks[dist[i] - 1])
-                     for i in live])
-                for i, e in zip(live, errs):
-                    if e is not None:
-                        alive[i] = False
-                        disk_errs[i] = e
-                if sum(alive) < wq:
-                    raise QuorumError(
-                        f"part write quorum lost ({sum(alive)}/{n})",
-                        [e for e in disk_errs if e is not None])
+            total, _, _ = eng._stream_shard_writes(
+                reader, eng.k, eng.m, eng.codec, dist, append_shard,
+                alive, disk_errs, wq, quorum_msg, md5)
             if hasattr(reader, "verify"):
                 reader.verify()
 
@@ -302,16 +317,36 @@ class MultipartUploads:
         def commit_one(i: int):
             disk = eng.disks[i]
             tmp_path = f"{TMP_PATH}/{uuid.uuid4()}"
+            link = getattr(disk, "link_file", None)
             try:
-                # COPY this disk's part shards into the staging data
+                # Stage this disk's part shards into the commit data
                 # dir, KEEPING the client's part numbers (SSE derives
                 # per-part keys from them, and ListParts reports them;
-                # ref AWS part-number semantics). Copy, not rename: a
+                # ref AWS part-number semantics). Not a rename: a
                 # failed quorum must leave the upload intact so the
                 # client can retry complete (cleanup happens only after
-                # quorum success).
+                # quorum success). Local disks HARD-LINK the immutable
+                # shard files (zero bytes moved — the dominant cost of
+                # complete for multi-GiB uploads); backends without
+                # link support fall back to read+write copy.
                 if total_size > 0:
                     for p in part_infos:
+                        if link is not None:
+                            try:
+                                link(MINIO_META_BUCKET,
+                                     f"{base}/part.{p.number}",
+                                     MINIO_META_BUCKET,
+                                     f"{tmp_path}/{data_dir}"
+                                     f"/part.{p.number}")
+                                continue
+                            except serr.FileNotFound:
+                                raise
+                            except serr.StorageError:
+                                # Filesystem without hard-link support
+                                # (FAT, some NFS/overlay mounts): take
+                                # the copy lane for the rest of this
+                                # disk's parts.
+                                link = None
                         shard = disk.read_all(MINIO_META_BUCKET,
                                               f"{base}/part.{p.number}")
                         disk.create_file(

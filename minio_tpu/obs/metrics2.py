@@ -308,3 +308,11 @@ METRICS2.register(
 METRICS2.register(
     "minio_tpu_v2_qos_bg_promotions_total", "counter",
     "Background dispatches promoted past busy foreground (aging).")
+METRICS2.register(
+    "minio_tpu_v2_pipeline_depth", "gauge",
+    "Configured depth of the data-plane pipelines, by pipeline.")
+METRICS2.register(
+    "minio_tpu_v2_pipeline_stall_seconds_total", "counter",
+    "Seconds a data-plane pipeline stage spent blocked on the other "
+    "side, by pipeline and stage (produce=worker waited on a full "
+    "queue, consume=consumer waited on an empty one).")

@@ -126,6 +126,43 @@ def submit(fn: Callable[..., Any], *args) -> Any:
     return f
 
 
+def first_success(fns: Sequence[Callable[[], Any]],
+                  swallow: type | tuple = Exception) -> Any:
+    """Race thunks on the shared pool; return the FIRST successful
+    result. Unlike parallel_map this never waits for the slowest thunk
+    — stragglers finish on the pool and are discarded. Thunks that
+    could not get a pool worker run inline with serial EARLY-EXIT (the
+    pre-parallel walk): under pool saturation a dead disk behind a
+    healthy one still costs nothing. Exceptions not matching `swallow`
+    propagate immediately; when every thunk fails, QuorumError carries
+    the swallowed errors."""
+    from concurrent.futures import FIRST_COMPLETED, wait
+    errs: list[BaseException] = []
+    futs = set()
+    inline = []
+    for fn in fns:
+        if _borrow(1):
+            f = _pool().submit(_qos_ctx_wrap(fn))
+            f.add_done_callback(lambda _f: _release(1))
+            futs.add(f)
+        else:
+            inline.append(fn)
+    while futs:
+        done, futs = wait(futs, return_when=FIRST_COMPLETED)
+        for fut in done:
+            try:
+                return fut.result()
+            except swallow as e:  # noqa: PERF203 — reduced below
+                errs.append(e)
+    for fn in inline:
+        try:
+            return fn()
+        except swallow as e:
+            errs.append(e)
+    raise QuorumError(
+        f"first_success: all {len(fns)} candidates failed", errs)
+
+
 def parallel_map(fns: Sequence[Callable[[], Any]],
                  ) -> tuple[list[Any], list[BaseException | None]]:
     """Run thunks concurrently; returns (results, errs) aligned by index.

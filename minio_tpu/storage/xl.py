@@ -170,20 +170,67 @@ class XLStorage(StorageAPI):
         (The microsecond residual window is absorbed by the engine's
         majority checks and heal sweeps.)"""
         self._check_vol(volume)
-        os.makedirs(dirpath, exist_ok=True)
+        try:
+            os.makedirs(dirpath, exist_ok=True)
+        except FileNotFoundError as e:
+            # A parent vanished mid-walk (racing force delete-bucket
+            # rmtree): re-check the volume — gone is the typed
+            # bucket-deleted condition the engine maps to NoSuchBucket;
+            # still present means the race interleaved mid-create, one
+            # retry rebuilds the chain. A second ENOENT means the
+            # volume is mid-rmtree right now: same typed condition.
+            self._check_vol(volume)
+            try:
+                os.makedirs(dirpath, exist_ok=True)
+            except FileNotFoundError:
+                raise serr.VolumeNotFound(volume) from e
 
     def _atomic_write(self, full: str, data: bytes,
-                      volume: str | None = None) -> None:
-        if volume is not None:
-            self._makedirs_for(volume, os.path.dirname(full))
-        else:
-            os.makedirs(os.path.dirname(full), exist_ok=True)
+                      volume: str | None = None,
+                      dir_ready: bool = False) -> None:
+        """dir_ready: the caller created (or just verified) the target
+        directory within this same storage call — skip the repeat
+        stat/mkdir. The replace below still fails ENOENT if a racing
+        delete removed the directory; that surfaces as FaultyDisk,
+        same as any other mid-commit disk mutation."""
+        if not dir_ready:
+            if volume is not None:
+                self._makedirs_for(volume, os.path.dirname(full))
+            else:
+                os.makedirs(os.path.dirname(full), exist_ok=True)
         tmp = os.path.join(self.root, TMP_DIR, str(uuid.uuid4()))
-        os.makedirs(os.path.dirname(tmp), exist_ok=True)
         try:
-            with open(tmp, "wb") as f:
+            try:
+                f = open(tmp, "wb")
+            except FileNotFoundError:
+                # tmp dir wiped under us (disk swap mid-flight): the
+                # system volume self-creates, then retry once.
+                os.makedirs(os.path.dirname(tmp), exist_ok=True)
+                f = open(tmp, "wb")
+            with f:
                 f.write(data)
-            os.replace(tmp, full)
+            try:
+                os.replace(tmp, full)
+            except FileNotFoundError:
+                # Target dir vanished mid-write (racing force
+                # delete-bucket rmtree, or delete()'s empty-parent
+                # pruning). Re-derive the TYPED cause: volume gone ->
+                # VolumeNotFound (the engine's commit guard maps it to
+                # NoSuchBucket, never a quorum 5xx); volume intact ->
+                # only the object dir was pruned, recreate + retry.
+                # _makedirs_for re-checks the volume first, so this
+                # never resurrects a deleted bucket.
+                if volume is None:
+                    raise
+                self._makedirs_for(volume, os.path.dirname(full))
+                try:
+                    os.replace(tmp, full)
+                except FileNotFoundError as e:
+                    # Deleted again between retry-mkdir and replace:
+                    # the volume is being torn down right now.
+                    raise serr.VolumeNotFound(volume) from e
+        except serr.StorageError:
+            raise
         except OSError as e:
             if e.errno == errno.ENOSPC:
                 raise serr.DiskFull(str(e))
@@ -244,10 +291,21 @@ class XLStorage(StorageAPI):
 
     def append_file(self, volume: str, path: str, data: bytes) -> None:
         full = self._file_path(volume, path)
-        self._makedirs_for(volume, os.path.dirname(full))
         try:
-            with _DiskOp("append_file", self.root), open(full, "ab") as f:
-                f.write(data)
+            with _DiskOp("append_file", self.root):
+                try:
+                    f = open(full, "ab")
+                except FileNotFoundError:
+                    # First append of a staged stream: create the
+                    # directory (volume-guarded) and retry. Later
+                    # appends of the same stream skip the stat/mkdir
+                    # pair — on the pipelined PUT path that's one
+                    # fewer round of metadata syscalls per disk per
+                    # batch.
+                    self._makedirs_for(volume, os.path.dirname(full))
+                    f = open(full, "ab")
+                with f:
+                    f.write(data)
         except OSError as e:
             if e.errno == errno.ENOSPC:
                 raise serr.DiskFull(str(e))
@@ -279,6 +337,38 @@ class XLStorage(StorageAPI):
             except OSError:
                 break
             parent = os.path.dirname(parent)
+
+    def link_file(self, src_volume: str, src_path: str,
+                  dst_volume: str, dst_path: str) -> None:
+        """Hard-link src to dst (same disk root, so same filesystem),
+        REPLACING dst if present — the zero-copy lane multipart
+        complete uses to stage immutable part shards into the commit
+        data dir without rewriting their bytes. Callers must treat the
+        linked file as immutable (shard files are append-once, read-
+        only after commit). Storage backends without link support
+        (remote RPC disks) simply don't expose this method; callers
+        fall back to read+write copy."""
+        self._check_vol(src_volume)
+        src = self._file_path(src_volume, src_path)
+        dst = self._file_path(dst_volume, dst_path)
+        self._makedirs_for(dst_volume, os.path.dirname(dst))
+        tmp = os.path.join(self.root, TMP_DIR, str(uuid.uuid4()))
+        try:
+            with _DiskOp("link_file", self.root):
+                # link to a tmp name then replace: os.link alone fails
+                # EEXIST on a dst left by a retried complete.
+                try:
+                    os.link(src, tmp)
+                except FileNotFoundError:
+                    os.makedirs(os.path.dirname(tmp), exist_ok=True)
+                    os.link(src, tmp)
+                os.replace(tmp, dst)
+        except FileNotFoundError:
+            raise serr.FileNotFound(f"{src_volume}/{src_path}")
+        except OSError as e:
+            if e.errno == errno.ENOSPC:
+                raise serr.DiskFull(str(e))
+            raise serr.FaultyDisk(str(e))
 
     def rename_file(self, src_volume: str, src_path: str, dst_volume: str,
                     dst_path: str) -> None:
@@ -346,7 +436,21 @@ class XLStorage(StorageAPI):
                 raise serr.FileNotFound(f"{src_volume}/{src_path}")
             if os.path.isdir(dst_dd):
                 shutil.rmtree(dst_dd)
-            os.replace(src_dd, dst_dd)
+            try:
+                os.replace(src_dd, dst_dd)
+            except FileNotFoundError:
+                # dst object dir vanished between the makedirs above
+                # and the replace (racing force delete-bucket, or a
+                # concurrent delete's empty-parent pruning): typed
+                # re-check — VolumeNotFound when the bucket is gone,
+                # recreate + retry when only the object dir was pruned
+                # (_makedirs_for re-checks the volume, so a deleted
+                # bucket is never resurrected).
+                self._makedirs_for(dst_volume, dst_obj_dir)
+                try:
+                    os.replace(src_dd, dst_dd)
+                except FileNotFoundError as e:
+                    raise serr.VolumeNotFound(dst_volume) from e
         try:
             meta = self._read_xlmeta(dst_volume, dst_path)
         except serr.FileNotFound:
@@ -363,14 +467,25 @@ class XLStorage(StorageAPI):
                     old = v
                     break
         meta.add_version(fi)
-        self._write_xlmeta(dst_volume, dst_path, meta)
+        # dir_ready: dst_obj_dir was created at the top of this call;
+        # xl.meta lives directly in it. volume still passed so a
+        # mid-commit ENOENT (racing delete) resolves typed.
+        self._atomic_write(
+            self._file_path(dst_volume,
+                            os.path.join(dst_path, XL_META_FILE)),
+            meta.dump(), volume=dst_volume, dir_ready=True)
         if old and old.get("dataDir") and old["dataDir"] != fi.data_dir:
             old_dd = os.path.join(dst_obj_dir, old["dataDir"])
             if os.path.isdir(old_dd):
                 shutil.rmtree(old_dd, ignore_errors=True)
-        # Clean the tmp staging dir.
+        # Clean the tmp staging dir — empty after the data-dir replace
+        # above, so a bare rmdir does it (rmtree's listdir walk only
+        # for the unusual leftover case).
         src_dir = self._file_path(src_volume, src_path)
-        shutil.rmtree(src_dir, ignore_errors=True)
+        try:
+            os.rmdir(src_dir)
+        except OSError:
+            shutil.rmtree(src_dir, ignore_errors=True)
 
     def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
         try:

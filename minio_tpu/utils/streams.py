@@ -17,6 +17,14 @@ from typing import Iterable, Iterator
 # memory at ~batch_bytes * (k+m)/k while keeping TPU batches dense).
 DEFAULT_BATCH_BYTES = 32 * 1024 * 1024
 
+# PUT-pipeline batch: one producer item of the bounded encode/write
+# pipeline (utils/pipeline.py). Smaller than DEFAULT_BATCH_BYTES so a
+# large part splits into several batches that actually overlap (encode
+# N+1 while N's shards fan out), while one batch still clears the
+# device-dispatch threshold (erasure/codec.TPU_MIN_BYTES) — and peak
+# PUT memory drops to ~(depth+1) × PUT_BATCH_BYTES × (k+m)/k.
+PUT_BATCH_BYTES = 8 * 1024 * 1024
+
 
 class Reader:
     """Minimal pull interface: read(n) -> up to n bytes, b'' at EOF."""
@@ -57,18 +65,44 @@ class IterReader(Reader):
 
 class LimitReader(Reader):
     """Caps a file-like object at `limit` bytes (an HTTP body whose
-    socket stays open past Content-Length)."""
+    socket stays open past Content-Length).
+
+    Reads are atomic (one lock): when a pipelined PUT fails mid-stream,
+    the server's keep-alive drain loop may briefly overlap with the
+    pipeline worker finishing its current batch read — serialized reads
+    keep the byte accounting (and therefore the connection framing)
+    exact no matter which thread consumes the remainder."""
 
     def __init__(self, f, limit: int):
+        import threading
         self._f = f
         self._left = limit
+        self._mu = threading.Lock()
 
     def read(self, n: int) -> bytes:
-        if self._left <= 0:
-            return b""
-        chunk = self._f.read(min(n, self._left))
-        self._left -= len(chunk)
-        return chunk
+        with self._mu:
+            if self._left <= 0:
+                return b""
+            chunk = self._f.read(min(n, self._left))
+            self._left -= len(chunk)
+            return chunk
+
+
+class PushbackReader(Reader):
+    """Prepends already-consumed bytes back onto an inner reader (the
+    one-byte lookahead the PUT pipeline uses to tell a final
+    exactly-full batch from a continuing stream)."""
+
+    def __init__(self, head: bytes, inner: Reader):
+        self._head = head
+        self._inner = inner
+
+    def read(self, n: int) -> bytes:
+        if self._head:
+            out = bytes(self._head[:n])
+            self._head = self._head[n:]
+            return out
+        return self._inner.read(n)
 
 
 class HashingReader(Reader):
@@ -151,13 +185,21 @@ def read_exactly(reader: Reader, n: int) -> bytes:
     return b"".join(parts)
 
 
+def batch_size(block_size: int,
+               batch_bytes: int = DEFAULT_BATCH_BYTES) -> int:
+    """The exact byte length of every non-final iter_batches batch —
+    the single source of truth callers (engine._stream_shard_writes)
+    use to recognize a final short batch."""
+    return max(1, batch_bytes // block_size) * block_size
+
+
 def iter_batches(reader: Reader, block_size: int,
                  batch_bytes: int = DEFAULT_BATCH_BYTES,
                  ) -> Iterator[bytes]:
     """Yield batches that are multiples of block_size (except the final
     short one), so downstream encode batches always align on stripe
     boundaries. Yields nothing for an empty stream."""
-    per = max(1, batch_bytes // block_size) * block_size
+    per = batch_size(block_size, batch_bytes)
     while True:
         chunk = read_exactly(reader, per)
         if not chunk:

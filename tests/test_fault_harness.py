@@ -348,7 +348,18 @@ def test_hot_single_drive_swap_heals_without_restart(cluster):
     assert all(len(_shard_files([target], "fault-swap", k)) == 1
                for k in bodies), "full shard placement never converged"
 
-    shutil.rmtree(target)          # hot drive swap: node keeps running
+    # Hot drive swap: node keeps running. The node may land a write
+    # mid-walk (rmtree's rmdir then sees a fresh entry — ENOTEMPTY);
+    # a real swap doesn't half-fail, so retry until the tree is gone.
+    deadline = time.time() + 30
+    while True:
+        try:
+            shutil.rmtree(target)
+            break
+        except OSError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.1)
     os.makedirs(target)
 
     # Converged = every shard re-populated AND the drive's identity

@@ -1,0 +1,558 @@
+"""Tests for the bounded data-plane pipeline (utils/pipeline.py) and
+its integration into the PUT / GET / heal paths (ISSUE 3)."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from minio_tpu.erasure import bitrot
+from minio_tpu.erasure.engine import ErasureObjects
+from minio_tpu.obs.metrics2 import METRICS2
+from minio_tpu.parallel.quorum import QuorumError
+from minio_tpu.storage.xl import MINIO_META_BUCKET, XLStorage
+from minio_tpu.utils.pipeline import (PIPE_STATS, DEFAULT_DEPTH,
+                                      PipelineStats, Prefetch)
+
+MB = 1024 * 1024
+
+
+def make_engine(tmp_path, n=6, k=4, m=2, block=256 * 1024):
+    disks = [XLStorage(os.path.join(str(tmp_path), f"disk{i}"))
+             for i in range(n)]
+    eng = ErasureObjects(disks, k, m, block_size=block)
+    eng.make_bucket("b")
+    return eng, disks
+
+
+# ---------------------------------------------------------------- unit
+
+
+def test_prefetch_preserves_order():
+    src = (i * 7 for i in range(100))
+    with Prefetch(src, depth=3, name="test") as pf:
+        assert list(pf) == [i * 7 for i in range(100)]
+
+
+def test_prefetch_propagates_midstream_exception_in_order():
+    class Boom(Exception):
+        pass
+
+    def src():
+        yield 1
+        yield 2
+        raise Boom("mid-stream")
+
+    pf = Prefetch(src(), depth=2, name="test")
+    got = []
+    with pytest.raises(Boom, match="mid-stream"):
+        for v in pf:
+            got.append(v)
+    # Every item produced BEFORE the failure was delivered first.
+    assert got == [1, 2]
+    pf.close()
+
+
+def test_prefetch_memory_bounded_producer_blocks_at_depth():
+    """With depth d, at most d+1 items are ever alive: d-1 queued, one
+    in the producer's hands (blocked on put), one at the consumer."""
+    depth = 2
+    live = [0]
+    max_live = [0]
+    produced = [0]
+
+    class Item:
+        def __init__(self):
+            live[0] += 1
+            produced[0] += 1
+            max_live[0] = max(max_live[0], live[0])
+
+        def release(self):
+            live[0] -= 1
+
+    def src():
+        for _ in range(20):
+            yield Item()
+
+    pf = Prefetch(src(), depth=depth, name="test")
+    # Consumer absent: the producer must stall after filling the queue
+    # (depth-1) plus the one item it holds awaiting space.
+    time.sleep(0.4)
+    assert produced[0] == depth, \
+        f"producer ran ahead: produced {produced[0]} at depth {depth}"
+    for item in pf:
+        item.release()
+        assert max_live[0] <= depth + 1
+    assert max_live[0] <= depth + 1
+    pf.close()
+
+
+def test_prefetch_depth_one_is_serial():
+    """depth=1 must mean NO worker: at most d+1 = 2 items alive, the
+    source pulled lazily on the consumer thread, errors propagated."""
+    live = [0]
+    max_live = [0]
+
+    class Item:
+        def __init__(self):
+            live[0] += 1
+            max_live[0] = max(max_live[0], live[0])
+
+        def release(self):
+            live[0] -= 1
+
+    def src():
+        for _ in range(5):
+            yield Item()
+
+    before = len([t for t in threading.enumerate()
+                  if t.name.startswith("pipe-")])
+    with Prefetch(src(), depth=1, name="test") as pf:
+        after = len([t for t in threading.enumerate()
+                     if t.name.startswith("pipe-")])
+        assert after == before, "depth-1 pipeline spawned a worker"
+        for item in pf:
+            item.release()
+    assert max_live[0] <= 2
+
+    def boom():
+        yield 1
+        raise RuntimeError("inline error")
+
+    pf = Prefetch(boom(), depth=1, name="test")
+    assert next(pf) == 1
+    with pytest.raises(RuntimeError, match="inline error"):
+        next(pf)
+    pf.close()
+
+
+def test_prefetch_close_unblocks_producer():
+    done = threading.Event()
+
+    def src():
+        try:
+            for i in range(1000):
+                yield i
+        finally:
+            done.set()
+
+    pf = Prefetch(src(), depth=2, name="test")
+    next(pf)
+    pf.close()
+    assert done.wait(5.0), "producer generator was not closed"
+
+
+def test_prefetch_records_stats_and_stall_metrics():
+    PIPE_STATS.reset()
+    before = METRICS2.get("minio_tpu_v2_pipeline_stall_seconds_total",
+                          {"pipeline": "test", "stage": "produce"})
+
+    def src():
+        for i in range(6):
+            yield i
+
+    with Prefetch(src(), depth=2, name="test") as pf:
+        for _ in pf:
+            time.sleep(0.02)  # slow consumer -> producer stalls
+    snap = PIPE_STATS.snapshot()["test"]
+    assert snap["items"] == 6
+    assert snap["wall_s"] > 0
+    assert METRICS2.get("minio_tpu_v2_pipeline_depth",
+                        {"pipeline": "test"}) == 2
+    after = METRICS2.get("minio_tpu_v2_pipeline_stall_seconds_total",
+                         {"pipeline": "test", "stage": "produce"})
+    assert after > before
+
+
+def test_prefetch_no_stall_recorded_when_never_blocked():
+    """Stall series must stay ZERO for a run where neither side ever
+    blocked — immediate queue ops are not stalls (operators read this
+    series to detect lost overlap)."""
+    PIPE_STATS.reset()
+    with Prefetch(iter([1, 2, 3]), depth=8, name="test-nostall") as pf:
+        time.sleep(0.3)  # producer finishes; queue holds everything
+        assert list(pf) == [1, 2, 3]
+    snap = PIPE_STATS.snapshot()["test-nostall"]
+    assert snap["produce_stall_s"] == 0.0
+    assert snap["consume_stall_s"] == 0.0
+
+
+def test_prefetch_stall_span_events():
+    from minio_tpu.obs.span import TRACER
+    root = TRACER.begin("test.pipeline", "trace-pipe")
+    assert root is not None
+    with root:
+        def src():
+            for i in range(4):
+                yield i
+
+        with Prefetch(src(), depth=2, name="test") as pf:
+            for _ in pf:
+                time.sleep(0.03)  # > STALL_EVENT_S -> producer stalls
+    names = {e["name"] for e in root.events}
+    assert "pipeline.stall" in names
+
+
+def test_overlap_factor_math():
+    before = {"x": {"runs": 1, "items": 2, "produce_s": 1.0,
+                    "produce_stall_s": 0.0, "consume_s": 1.0,
+                    "consume_stall_s": 0.0, "wall_s": 2.0}}
+    after = {"x": {"runs": 2, "items": 6, "produce_s": 2.0,
+                   "produce_stall_s": 0.0, "consume_s": 2.0,
+                   "consume_stall_s": 0.0, "wall_s": 3.5}}
+    f = PipelineStats.overlap_factor(before, after, "x")
+    assert f == pytest.approx((1.0 + 1.0) / 1.5)
+    assert PipelineStats.overlap_factor(before, after, "absent") is None
+
+
+# ---------------------------------------------------- framing goldens
+
+
+def test_frame_shard_matches_central_framing():
+    rng = np.random.default_rng(0)
+    S = 1024
+    full = rng.integers(0, 256, (5, S)).astype(np.uint8)
+    tail = rng.integers(0, 256, 300).astype(np.uint8).tobytes()
+    central = bitrot.encode_stream_arrays([full])[0].tobytes() + \
+        bitrot.encode_streams([tail], S)[0]
+    assert bitrot.frame_shard(full, tail) == central
+    # Whole-stream equivalence: framing the concatenated bytes in one
+    # go produces the same shard file.
+    whole = bitrot.encode_stream(full.tobytes() + tail, S)
+    assert bitrot.frame_shard(full, tail) == whole
+
+
+def test_groupwise_heal_framing_concatenates():
+    """Per-group bitrot framing (heal's streamed write-back) must
+    concatenate byte-identically to whole-shard framing."""
+    rng = np.random.default_rng(1)
+    S = 512
+    data = rng.integers(0, 256, 5 * S + 77).astype(np.uint8).tobytes()
+    whole = bitrot.encode_stream(data, S)
+    grouped = (bitrot.encode_stream(data[:2 * S], S)
+               + bitrot.encode_stream(data[2 * S:4 * S], S)
+               + bitrot.encode_stream(data[4 * S:], S))
+    assert grouped == whole
+
+
+# ------------------------------------------------------- PUT pipeline
+
+
+class FlakyDisk:
+    """Delegates to an XLStorage, failing append_file after a count."""
+
+    def __init__(self, inner, fail_after):
+        self._inner = inner
+        self._appends = 0
+        self._fail_after = fail_after
+
+    def append_file(self, volume, path, data):
+        self._appends += 1
+        if self._appends > self._fail_after:
+            raise OSError("injected disk failure")
+        return self._inner.append_file(volume, path, data)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def _tmp_stage_entries(disks):
+    out = []
+    for d in disks:
+        root = getattr(d, "_inner", d).root
+        tmp = os.path.join(root, MINIO_META_BUCKET, "tmp")
+        if os.path.isdir(tmp):
+            out.extend(os.listdir(tmp))
+    return out
+
+
+def test_put_pipelined_multibatch_roundtrip(tmp_path):
+    eng, disks = make_engine(tmp_path)
+    eng.put_batch_bytes = eng.block_size  # several batches per object
+    body = np.random.default_rng(2).integers(
+        0, 256, 5 * eng.block_size + 123).astype(np.uint8).tobytes()
+    PIPE_STATS.reset()
+    info = eng.put_object("b", "obj", body)
+    assert info.size == len(body)
+    got, _ = eng.get_object("b", "obj")
+    assert got == body
+    snap = PIPE_STATS.snapshot()
+    assert snap["put"]["items"] == 6  # 5 full batches + tail
+
+
+def test_put_single_batch_skips_worker(tmp_path):
+    eng, disks = make_engine(tmp_path)
+    body = b"x" * (eng.block_size // 2)
+    PIPE_STATS.reset()
+    eng.put_object("b", "small", body)
+    assert "put" not in PIPE_STATS.snapshot()
+    got, _ = eng.get_object("b", "small")
+    assert got == body
+
+
+def test_put_exactly_one_full_batch_stays_inline(tmp_path):
+    """A stream of exactly put_batch_bytes is still single-batch: the
+    one-byte lookahead keeps it off the worker thread."""
+    eng, disks = make_engine(tmp_path)
+    eng.put_batch_bytes = eng.block_size
+    body = b"z" * eng.block_size  # == one full batch, then EOF
+    PIPE_STATS.reset()
+    eng.put_object("b", "exact", body)
+    assert "put" not in PIPE_STATS.snapshot()
+    got, _ = eng.get_object("b", "exact")
+    assert got == body
+
+
+def test_first_success_races_and_early_exits():
+    from minio_tpu.parallel.quorum import first_success
+
+    class Probe(Exception):
+        pass
+
+    calls = []
+
+    def mk(i, fail=False, sleep=0.0):
+        def fn():
+            calls.append(i)
+            if sleep:
+                time.sleep(sleep)
+            if fail:
+                raise Probe(f"disk{i}")
+            return i
+        return fn
+
+    # A slow straggler must not gate the fast success.
+    t0 = time.perf_counter()
+    got = first_success([mk(0, sleep=1.0), mk(1)], swallow=Probe)
+    assert got in (0, 1)
+    assert time.perf_counter() - t0 < 0.9
+    # All failing -> QuorumError carrying the swallowed errors.
+    with pytest.raises(QuorumError):
+        first_success([mk(0, fail=True), mk(1, fail=True)],
+                      swallow=Probe)
+    # Non-swallowed exceptions propagate.
+    with pytest.raises(ValueError):
+        first_success([lambda: (_ for _ in ()).throw(ValueError("x"))],
+                      swallow=Probe)
+
+
+def test_put_quorum_loss_midstream_same_error_and_cleanup(tmp_path):
+    """A disk failing between batches degrades per batch at the join
+    point; losing write quorum mid-stream raises the SAME error text
+    as the serial loop did and leaves no staged tmp shards behind."""
+    eng, disks = make_engine(tmp_path)
+    eng.put_batch_bytes = eng.block_size
+    # Fail 3 of 6 disks (m=2 -> quorum k=4 lost) after their 2nd batch.
+    eng.disks = [FlakyDisk(d, 2) if i < 3 else d
+                 for i, d in enumerate(disks)]
+    body = b"y" * (6 * eng.block_size)
+    with pytest.raises(QuorumError, match="write quorum lost "
+                                          "mid-stream"):
+        eng.put_object("b", "doomed", body)
+    assert _tmp_stage_entries(eng.disks) == []
+    with pytest.raises(Exception):
+        eng.get_object("b", "doomed")
+
+
+def test_put_survives_single_disk_failure_between_batches(tmp_path):
+    eng, disks = make_engine(tmp_path)
+    eng.put_batch_bytes = eng.block_size
+    eng.disks = [FlakyDisk(disks[0], 2)] + disks[1:]
+    body = np.random.default_rng(3).integers(
+        0, 256, 5 * eng.block_size).astype(np.uint8).tobytes()
+    info = eng.put_object("b", "obj", body)
+    assert info.size == len(body)
+    got, _ = eng.get_object("b", "obj")
+    assert got == body
+
+
+def test_put_pipeline_memory_bounded_end_to_end(tmp_path):
+    """A PUT of X MiB at depth d never holds more than d+1 encoded
+    batches alive (the ISSUE-3 acceptance bound)."""
+    eng, disks = make_engine(tmp_path)
+    eng.put_batch_bytes = eng.block_size
+    live = [0]
+    max_live = [0]
+
+    class CountedBatch:
+        """Wraps a split-encode result; alive while referenced."""
+
+        def __init__(self, inner):
+            self.inner = inner
+            live[0] += 1
+            max_live[0] = max(max_live[0], live[0])
+
+        def __del__(self):
+            live[0] -= 1
+
+        # _stream_shard_writes touches these on the full_sm half:
+        @property
+        def nbytes(self):
+            return self.inner.nbytes
+
+        def __getitem__(self, j):
+            return self.inner[j]
+
+    orig = ErasureObjects._encode_batch_split
+
+    def counted(self, data, k, m, codec):
+        full_sm, tails = orig(self, data, k, m, codec)
+        return (CountedBatch(full_sm) if full_sm is not None
+                else None), tails
+
+    slow = {"orig": XLStorage.append_file}
+
+    def slow_append(self, volume, path, data):
+        time.sleep(0.005)  # make writes the slow stage
+        return slow["orig"](self, volume, path, data)
+
+    body = np.random.default_rng(4).integers(
+        0, 256, 10 * eng.block_size).astype(np.uint8).tobytes()
+    ErasureObjects._encode_batch_split = counted
+    XLStorage.append_file = slow_append
+    try:
+        eng.put_object("b", "big", body)
+    finally:
+        ErasureObjects._encode_batch_split = orig
+        XLStorage.append_file = slow["orig"]
+    assert max_live[0] <= eng.pipeline_depth + 1, \
+        (f"{max_live[0]} encoded batches alive at depth "
+         f"{eng.pipeline_depth}")
+    got, _ = eng.get_object("b", "big")
+    assert got == body
+
+
+# ------------------------------------------------------- GET pipeline
+
+
+def test_get_readahead_golden_vs_inline(tmp_path):
+    """The pipelined (multi-group read-ahead) GET returns byte-identical
+    plaintext to the single-group inline path — including with 2 shards
+    lost — and for arbitrary sub-ranges."""
+    eng, disks = make_engine(tmp_path, n=12, k=8, m=4)
+    body = np.random.default_rng(5).integers(
+        0, 256, 24 * eng.block_size + 321).astype(np.uint8).tobytes()
+    eng.put_object("b", "obj", body)
+
+    def read(group_bytes, offset=0, length=-1):
+        eng.read_group_bytes = group_bytes
+        got, _ = eng.get_object("b", "obj", offset=offset,
+                                length=length)
+        return got
+
+    inline = read(len(body) * 2)         # one group: no pipeline
+    PIPE_STATS.reset()
+    piped = read(4 * eng.block_size)     # many groups: read-ahead
+    assert piped == inline == body
+    assert PIPE_STATS.snapshot()["get"]["items"] >= 2
+
+    # Ranged read crossing group boundaries.
+    off, ln = 3 * eng.block_size + 7, 9 * eng.block_size + 100
+    assert read(4 * eng.block_size, off, ln) == body[off:off + ln]
+
+    # 2 shards lost: reconstruction through the pipeline, same bytes.
+    import shutil
+    for d in disks[:2]:
+        shutil.rmtree(os.path.join(d.root, "b", "obj"),
+                      ignore_errors=True)
+    assert read(4 * eng.block_size) == body
+    assert read(len(body) * 2) == body
+
+
+def test_get_stream_abandon_stops_pipeline(tmp_path):
+    """Closing a streaming GET mid-body shuts the read-ahead worker
+    down and releases the namespace lock."""
+    eng, disks = make_engine(tmp_path)
+    eng.read_group_bytes = eng.block_size
+    body = np.random.default_rng(6).integers(
+        0, 256, 8 * eng.block_size).astype(np.uint8).tobytes()
+    eng.put_object("b", "obj", body)
+    _, stream = eng.get_object_stream("b", "obj")
+    next(iter(stream))
+    stream.close()
+    # Lock released: an exclusive writer can take the key immediately.
+    with eng.ns_lock.write_locked("b", "obj", timeout=2.0):
+        pass
+    alive = [t.name for t in threading.enumerate()
+             if t.name.startswith("pipe-get")]
+    deadline = time.monotonic() + 5.0
+    while alive and time.monotonic() < deadline:
+        time.sleep(0.05)
+        alive = [t.name for t in threading.enumerate()
+                 if t.name.startswith("pipe-get")]
+    assert not alive, f"read-ahead workers leaked: {alive}"
+
+
+# ------------------------------------------------------ heal pipeline
+
+
+def test_heal_pipelined_multigroup_object(tmp_path, monkeypatch):
+    """Heal of an object spanning several reconstruct groups streams
+    group-by-group; the healed shard passes the deep bitrot scan and
+    serves correct bytes."""
+    from minio_tpu.erasure import heal as heal_mod
+    monkeypatch.setattr(heal_mod, "HEAL_BATCH_BYTES", 2 * 256 * 1024)
+    eng, disks = make_engine(tmp_path)
+    body = np.random.default_rng(7).integers(
+        0, 256, 8 * eng.block_size + 99).astype(np.uint8).tobytes()
+    eng.put_object("b", "obj", body)
+    import shutil
+    shutil.rmtree(os.path.join(disks[0].root, "b", "obj"))
+    PIPE_STATS.reset()
+    res = eng.healer.heal_object("b", "obj")
+    assert res.healed_disks == [0]
+    assert res.after_ok == len(disks)
+    assert PIPE_STATS.snapshot()["heal"]["items"] >= 2
+    # The healed disk's shard must be a valid streaming-bitrot file.
+    fi = disks[0].read_version("b", "obj")
+    disks[0].verify_file("b", "obj", fi)
+    # And the object decodes from a set that NEEDS the healed disk.
+    for d in disks[1:3]:
+        shutil.rmtree(os.path.join(d.root, "b", "obj"))
+    got, _ = eng.get_object("b", "obj")
+    assert got == body
+
+
+def test_multipart_complete_link_failure_falls_back_to_copy(
+        tmp_path, monkeypatch):
+    """A filesystem without hard-link support (link_file raising a
+    StorageError) must not break complete: the copy lane takes over."""
+    from minio_tpu.storage import errors as serr
+
+    def no_link(self, *a, **kw):
+        raise serr.FaultyDisk("EPERM: links not supported")
+
+    monkeypatch.setattr(XLStorage, "link_file", no_link)
+    eng, disks = make_engine(tmp_path)
+    eng.multipart.min_part_size = 1
+    body = np.random.default_rng(9).integers(
+        0, 256, 3 * eng.block_size + 11).astype(np.uint8).tobytes()
+    up = eng.multipart.new_multipart_upload("b", "obj")
+    half = len(body) // 2
+    etags = []
+    for num, piece in ((1, body[:half]), (2, body[half:])):
+        info = eng.multipart.put_object_part("b", "obj", up, num, piece)
+        etags.append((num, info["etag"]))
+    eng.multipart.complete_multipart_upload("b", "obj", up, etags)
+    got, _ = eng.get_object("b", "obj")
+    assert got == body
+
+
+def test_heal_tolerates_bad_disk_write_failure(tmp_path, monkeypatch):
+    """One bad disk failing its write-back drops out; the other still
+    heals (per-disk isolation, as before the pipeline)."""
+    from minio_tpu.erasure import heal as heal_mod
+    monkeypatch.setattr(heal_mod, "HEAL_BATCH_BYTES", 2 * 256 * 1024)
+    eng, disks = make_engine(tmp_path)
+    body = np.random.default_rng(8).integers(
+        0, 256, 6 * eng.block_size).astype(np.uint8).tobytes()
+    eng.put_object("b", "obj", body)
+    import shutil
+    shutil.rmtree(os.path.join(disks[0].root, "b", "obj"))
+    shutil.rmtree(os.path.join(disks[1].root, "b", "obj"))
+    eng.disks = [FlakyDisk(disks[0], 0)] + disks[1:]
+    res = eng.healer.heal_object("b", "obj")
+    assert res.healed_disks == [1]
+    assert _tmp_stage_entries(eng.disks) == []

@@ -1,7 +1,7 @@
 """Fast observability lint, wired into the tier-1 path
 (tests/test_observability.py runs main() and fails on any violation).
 
-Three invariants, all cheap AST walks:
+Four invariants, all cheap AST walks:
 
 1. No bare ``assert`` used for error handling in ``minio_tpu/native/``:
    a ``python -O`` run strips asserts, which would let a garbled native
@@ -19,6 +19,10 @@ Three invariants, all cheap AST walks:
    layer's shed/wait/lane numbers are the acceptance evidence for
    brownout behavior, so a dynamically-built (unlintable) or typoed
    name there is a lint failure, not a runtime surprise.
+
+4. The same literal-registered-name bar for the data-plane pipeline's
+   recordings (``minio_tpu/utils/pipeline.py``): the depth/stall
+   series are how operators and bench.py detect lost overlap.
 
 Run standalone: ``python -m tools.obs_lint``.
 """
@@ -94,15 +98,16 @@ def check_metric_names() -> list[str]:
     return violations
 
 
-def check_qos_metric_calls() -> list[str]:
-    """Recording calls in minio_tpu/qos/ must use literal registered
-    names (rule 2 only sees string literals — a name built at runtime
-    would slip past it; here the CALL itself is the unit checked)."""
+def _check_literal_metric_calls(paths, what: str) -> list[str]:
+    """Every METRICS2 recording call (inc/observe/set_gauge) in `paths`
+    must pass a literal, registered metric name (rule 2 only sees
+    string literals — a name built at runtime would slip past it; here
+    the CALL itself is the unit checked)."""
     from minio_tpu.obs.metrics2 import METRICS2
     registered = METRICS2.registered_names()
     recorders = {"inc", "observe", "set_gauge"}
     violations = []
-    for path in _py_files(os.path.join(PKG, "qos")):
+    for path in paths:
         with open(path, encoding="utf-8") as f:
             tree = ast.parse(f.read(), filename=path)
         for node in ast.walk(tree):
@@ -117,22 +122,40 @@ def check_qos_metric_calls() -> list[str]:
                     isinstance(node.args[0], ast.Constant)
                     and isinstance(node.args[0].value, str)):
                 violations.append(
-                    f"{rel}:{node.lineno}: qos metric call must pass a "
-                    "literal metric name (dynamic names are unlintable)")
+                    f"{rel}:{node.lineno}: {what} metric call must pass "
+                    "a literal metric name (dynamic names are "
+                    "unlintable)")
                 continue
             name = node.args[0].value
             if name not in registered:
                 violations.append(
-                    f"{rel}:{node.lineno}: qos metric {name!r} is not "
-                    "registered in minio_tpu/obs/metrics2.py")
+                    f"{rel}:{node.lineno}: {what} metric {name!r} is "
+                    "not registered in minio_tpu/obs/metrics2.py")
     return violations
+
+
+def check_qos_metric_calls() -> list[str]:
+    """Rule 3: the QoS layer's shed/wait/lane numbers are the
+    acceptance evidence for brownout behavior — typoed or dynamic
+    names there are a lint failure, not a runtime surprise."""
+    return _check_literal_metric_calls(
+        _py_files(os.path.join(PKG, "qos")), "qos")
+
+
+def check_pipeline_metric_calls() -> list[str]:
+    """Rule 4: the data-plane pipeline's depth/stall series
+    (utils/pipeline.py) are how operators and bench.py detect lost
+    overlap — same literal-registered-name bar as the qos layer."""
+    return _check_literal_metric_calls(
+        [os.path.join(PKG, "utils", "pipeline.py")], "pipeline")
 
 
 def main() -> int:
     if REPO not in sys.path:
         sys.path.insert(0, REPO)
     violations = (check_native_asserts() + check_metric_names()
-                  + check_qos_metric_calls())
+                  + check_qos_metric_calls()
+                  + check_pipeline_metric_calls())
     for v in violations:
         print(v)
     if violations:
