@@ -237,17 +237,51 @@ def bench_put_p50(np, workdir: str) -> dict:
         for i in range(5):
             client.put_object("bench", f"warm-{i}", body)
         PUT.reset()
-        lat = []
-        for i in range(50):
-            t0 = time.perf_counter()
-            r = client.put_object("bench", f"obj-{i}", body)
-            lat.append(time.perf_counter() - t0)
-            if r.status != 200:
-                raise RuntimeError(f"PutObject failed: {r.status}")
-        p50_ms = statistics.median(lat) * 1e3
+
+        # Acceptance: drivemon+slowlog recording overhead on this path
+        # must measure <= 2%. This VM's throughput drifts +/-20% on
+        # second timescales, so pool-median A/B aliases drift into the
+        # comparison; instead each recording-ON PUT is PAIRED with the
+        # immediately-following recording-OFF PUT (toggling is two
+        # attribute writes) and the overhead is the median of the
+        # per-pair deltas — drift moves both halves of a pair
+        # together, the systematic recording cost survives.
+        from minio_tpu.obs.drivemon import DRIVEMON
+        from minio_tpu.obs.slowlog import SLOWLOG
+        lat_on: list = []
+        lat_off: list = []
+        try:
+            for i in range(80):
+                # Alternate which half leads: a fixed on-first order
+                # would alias any position-within-pair effect (post-
+                # pair stalls, allocator periodicity) into the delta.
+                order = (True, False) if i % 2 == 0 else (False, True)
+                for on in order:
+                    DRIVEMON.enabled = SLOWLOG.enabled = on
+                    t0 = time.perf_counter()
+                    r = client.put_object(
+                        "bench", f"obj-{i}-{int(on)}", body)
+                    (lat_on if on else lat_off).append(
+                        time.perf_counter() - t0)
+                    if r.status != 200:
+                        raise RuntimeError(
+                            f"PutObject failed: {r.status}")
+        finally:
+            DRIVEMON.enabled = SLOWLOG.enabled = True
+        p50_ms = statistics.median(lat_on) * 1e3
+        p50_off_ms = statistics.median(lat_off) * 1e3
+        med_delta_ms = statistics.median(
+            [(a - b) * 1e3 for a, b in zip(lat_on, lat_off)])
+        overhead_pct = med_delta_ms / max(p50_off_ms, 1e-9) * 100.0
         return {"metric": "ec4+2_put_p50", "value": round(p50_ms, 3),
-                "unit": "ms", "objects": 50, "object_bytes": len(body),
+                "unit": "ms", "objects": len(lat_on),
+                "object_bytes": len(body),
                 "workdir": "tmpfs" if base != workdir else "disk",
+                # Drive-health + slowlog recording cost on the hot
+                # path (acceptance bar: <= 2%; sub-ms medians make
+                # small negatives normal measurement noise).
+                "put_p50_no_obs_ms": round(p50_off_ms, 3),
+                "obs_overhead_pct": round(overhead_pct, 2),
                 # Round-4 verdict weak #3: publish where the ms go.
                 "phase_p50_ms": {k: v["p50_ms"] for k, v in
                                  sorted(PUT.snapshot().items())}}
@@ -468,6 +502,15 @@ def bench_qos_brownout(np, workdir: str) -> dict:
             client.put_object("bench", f"warm-{i}", body)
 
         # -- brownout: loadgen at ~4x the write cap ---------------------
+        # Sheds are DELIBERATE backpressure: they must not pollute the
+        # slow-request log or its blame histogram. Asserted via the
+        # slowlog's exemption counter — every 503 the loadgen saw must
+        # have been EXEMPTED (shed/deadline), not captured. (Raw 503
+        # entry counts can't distinguish a leaked shed from a quorum
+        # 503, which the slowlog deliberately captures.)
+        from minio_tpu.obs.slowlog import SLOWLOG
+        slowlog_before = SLOWLOG.total
+        exempted_before = SLOWLOG.exempted
         srv.config.set_kv(f"api requests_max_write={write_cap} "
                           "requests_deadline=250ms")
         brown = run_load("127.0.0.1", port, access, secret, "bench",
@@ -475,6 +518,12 @@ def bench_qos_brownout(np, workdir: str) -> dict:
                          put_fraction=1.0, object_bytes=len(body))
         srv.config.set_kv("api requests_max_write=0 "
                           "requests_deadline=10s")
+        exempted = SLOWLOG.exempted - exempted_before
+        if exempted < brown["shed_503"]:
+            raise RuntimeError(
+                f"only {exempted} of {brown['shed_503']} shed 503s "
+                "were slowlog-exempt (sheds leaked into the blame "
+                "histogram)")
 
         def put_lat(tag: str, n: int = 14) -> list[float]:
             lat = []
@@ -531,6 +580,9 @@ def bench_qos_brownout(np, workdir: str) -> dict:
                 "minio_tpu_v2_qos_bg_deferrals_total"),
             "bg_promotions": METRICS2.get(
                 "minio_tpu_v2_qos_bg_promotions_total"),
+            # Asserted above: every shed was slowlog-exempt.
+            "slowlog_exempted_sheds": exempted,
+            "slowlog_entries_during": SLOWLOG.total - slowlog_before,
         }
     finally:
         srv.stop()
@@ -656,6 +708,12 @@ def main() -> None:
     # regressions. put_p50's 1MiB objects fit one encode batch, so its
     # pipeline never engages and no factor is reported there.
     from minio_tpu.utils.pipeline import PIPE_STATS, PipelineStats
+    # Silent-degradation tripwires per config: slowlog captures during
+    # the run plus the drive-health suspect/faulty census afterwards —
+    # a future regression that makes a config quietly slow (or drags
+    # one disk) shows up in the BENCH record, not just in the value.
+    from minio_tpu.obs.drivemon import DRIVEMON
+    from minio_tpu.obs.slowlog import SLOWLOG
     config_pipeline = {"put_p50": "put", "multipart": "put",
                        "get_2lost": "get", "heal": "heal"}
     configs: list[dict] = []
@@ -675,12 +733,17 @@ def main() -> None:
         def run_measured(fn=fn, pipe=pipe, factor_box=factor_box):
             # Snapshot per ATTEMPT: a failed first try's partial
             # pipeline stats must not pollute the successful run's
-            # overlap factor.
+            # overlap factor. The drive monitor RESETS per attempt —
+            # a suspect frozen from an earlier config's destroyed
+            # disks must not leak into this config's tripwire.
+            DRIVEMON.reset()
             before = PIPE_STATS.snapshot()
+            slow_before = SLOWLOG.total
             out = fn()
             if pipe is not None:
                 factor_box["factor"] = PipelineStats.overlap_factor(
                     before, PIPE_STATS.snapshot(), pipe)
+            factor_box["slowlog"] = SLOWLOG.total - slow_before
             return out
 
         res, err = _retrying(run_measured, name, attempts=2,
@@ -689,6 +752,10 @@ def main() -> None:
             res["device_asserted"] = False
             if factor_box.get("factor") is not None:
                 res["overlap_factor"] = round(factor_box["factor"], 3)
+            res["slowlog_entries"] = factor_box.get("slowlog", 0)
+            suspect, faulty = DRIVEMON.counts()
+            res["drive_suspect"] = suspect
+            res["drive_faulty"] = faulty
             configs.append(res)
         else:
             errors[name] = err or "unknown"

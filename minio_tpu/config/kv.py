@@ -70,6 +70,18 @@ DEFAULT_KVS: dict[str, dict[str, str]] = {
         "endpoint": "",
         "auth_token": "",
     },
+    # Slow-request capture SLOs (obs/slowlog.py): any request past its
+    # class threshold (ms) lands in the slowlog ring with per-layer
+    # blame. Per-class keys override the default; empty = inherit;
+    # 0 disables the latency trigger (5xx capture stays on).
+    "obs": {
+        "slow_ms": "1000",
+        "slow_ms_read": "",
+        "slow_ms_write": "",
+        "slow_ms_list": "",
+        "slow_ms_admin": "",
+        "profile_on_slow": "off",
+    },
 }
 
 

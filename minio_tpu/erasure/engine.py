@@ -147,6 +147,19 @@ class ErasureObjects:
         self.k = data_shards
         self.m = parity_shards
         self.block_size = block_size
+        # Drive-health peer group: this set's disks score each other's
+        # latency EWMAs relative to the set median (obs/drivemon.py) —
+        # a laggard drive is only an outlier against its own quorum
+        # peers, never against unrelated pools.
+        from ..obs.drivemon import DRIVEMON
+
+        def _ep(d) -> str:
+            try:
+                return d.endpoint()
+            except Exception:  # duck-typed test doubles
+                return str(d)
+
+        DRIVEMON.register_set([_ep(d) for d in self.disks])
         # Streaming-pipeline knobs: how many bytes one encode dispatch /
         # one read window group covers, and how many batches/groups may
         # be in flight at once (utils/pipeline.py). Peak data-plane
@@ -1156,8 +1169,14 @@ class ErasureObjects:
             need = [i for i, (_, _, sh) in enumerate(gathered)
                     if any(sh[j] is None for j in range(k))]
             if need:
-                decoded = codec.decode_data_blocks_batch(
-                    [gathered[i][2] for i in need])
+                # Kernel child span: without it a degraded read's
+                # reconstruct math hides in root self-time and the
+                # slowlog blames client-stream instead of the codec.
+                with TRACER.span("kernel.rs_decode",
+                                 parent=_read_parent,
+                                 blocks=len(need)):
+                    decoded = codec.decode_data_blocks_batch(
+                        [gathered[i][2] for i in need])
                 for i, dec in zip(need, decoded):
                     gathered[i] = (gathered[i][0], gathered[i][1], dec)
 

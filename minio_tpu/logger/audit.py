@@ -20,8 +20,14 @@ import urllib.request
 def audit_entry(api: str, method: str, path: str, status: int,
                 duration_ms: float, rx: int, tx: int,
                 access_key: str = "", request_id: str = "",
-                remote: str = "") -> dict:
-    """Entry shape follows the reference's audit.Entry fields."""
+                remote: str = "", qos_class: str = "",
+                blamed_layer: str = "") -> dict:
+    """Entry shape follows the reference's audit.Entry fields, plus
+    the join keys against this stack's observability planes: trace_id
+    (= the request id every span tree is keyed by), the QoS admission
+    class, and — when the request landed in the slow-request log — the
+    blamed layer, so the webhook stream correlates with the slowlog
+    without a second lookup."""
     return {
         "version": "1",
         "deploymentid": "minio-tpu",
@@ -33,6 +39,9 @@ def audit_entry(api: str, method: str, path: str, status: int,
             "rx": rx, "tx": tx,
         },
         "requestID": request_id,
+        "trace_id": request_id,
+        "qos_class": qos_class,
+        "blamed_layer": blamed_layer,
         "accessKey": access_key,
         "remotehost": remote,
     }
@@ -67,6 +76,11 @@ class AuditWebhook:
         except queue.Full:
             with self._stats_mu:
                 self.dropped += 1
+
+    def queued(self) -> int:
+        """Entries waiting for the delivery worker (status surface —
+        admin audit-status must not reach into the private queue)."""
+        return self._q.qsize()
 
     def _run(self) -> None:
         while True:

@@ -316,3 +316,29 @@ METRICS2.register(
     "Seconds a data-plane pipeline stage spent blocked on the other "
     "side, by pipeline and stage (produce=worker waited on a full "
     "queue, consume=consumer waited on an empty one).")
+METRICS2.register(
+    "minio_tpu_v2_drive_state", "gauge",
+    "Drive health state by disk endpoint "
+    "(0=ok, 1=suspect, 2=faulty).")
+METRICS2.register(
+    "minio_tpu_v2_drive_state_transitions_total", "counter",
+    "Drive health state transitions, by disk endpoint and new state.")
+METRICS2.register(
+    "minio_tpu_v2_drive_op_latency_ewma_ms", "gauge",
+    "Rolling per-drive op-class latency EWMA in milliseconds "
+    "(published on health-state transitions).")
+METRICS2.register(
+    "minio_tpu_v2_drive_op_errors_total", "counter",
+    "Drive op errors (real disk faults, not namespace misses), "
+    "by disk endpoint and op class.")
+METRICS2.register(
+    "minio_tpu_v2_slow_requests_total", "counter",
+    "Requests captured by the slow-request log, by API class and "
+    "blamed layer.")
+METRICS2.register(
+    "minio_tpu_v2_slow_request_duration_ms", "histogram",
+    "Latency of slowlog-captured requests in milliseconds, by API "
+    "class and blamed layer.")
+METRICS2.register(
+    "minio_tpu_v2_profile_bursts_total", "counter",
+    "Profile-on-slow sampling bursts triggered by slow-rate spikes.")

@@ -124,6 +124,12 @@ class PeerRPCService:
         from ..obs.metrics2 import METRICS2
         return ({"metrics2": METRICS2.snapshot()}, b"")
 
+    def rpc_drivemon(self, args: dict, payload: bytes):
+        """This node's drive-health snapshot for the cluster drive
+        endpoint's fan-in merge (same peer-scrape shape as metrics2)."""
+        from ..obs.drivemon import DRIVEMON
+        return ({"drivemon": DRIVEMON.snapshot()}, b"")
+
     def rpc_server_info(self, args: dict, payload: bytes):
         srv = self._server()
         return ({"version": __version__,
@@ -333,6 +339,12 @@ class NotificationSys:
         actually contributed)."""
         return {k: (v if isinstance(v, dict) else {"error": str(v)})
                 for k, v in self._fanout("metrics2", {}).items()}
+
+    def drivemon_all(self) -> dict:
+        """Per-peer drive-health snapshots for the cluster drives
+        endpoint (unreachable peers degrade, never the scrape)."""
+        return {k: (v if isinstance(v, dict) else {"error": str(v)})
+                for k, v in self._fanout("drivemon", {}).items()}
 
     def server_info_all(self) -> dict:
         return {k: (v if isinstance(v, dict) else {"error": str(v)})

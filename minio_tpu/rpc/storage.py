@@ -171,6 +171,12 @@ class RemoteStorage(StorageAPI):
     def __repr__(self) -> str:
         return f"RemoteStorage({self.client.endpoint()}{self.disk_path})"
 
+    def _drive_key(self) -> str:
+        """Drive-health identity of this remote disk (duck-typed:
+        in-process loopback clients in tests have no endpoint())."""
+        host = getattr(self.client, "endpoint", lambda: "?")()
+        return f"{host}{self.disk_path}"
+
     def _call(self, method: str, args: dict | None = None,
               payload: bytes = b"") -> tuple[dict, bytes]:
         a = {"disk": self.disk_path}
@@ -182,18 +188,34 @@ class RemoteStorage(StorageAPI):
         ddl = current_deadline()
         if ddl is not None:
             ddl.check(f"rpc.storage.{method}")
+        # Drive-health accounting at the CLIENT boundary: wire time
+        # included, because that is what this node's quorum fan-outs
+        # actually wait on for a remote disk (obs/drivemon.py).
+        import time as _time
+        from ..obs.drivemon import DRIVEMON, is_drive_fault
         from ..obs.span import TRACER, current_span
-        if current_span() is None:  # untraced fast path: no tag work
-            return self.client.call("storage", method, a, payload)
-        # Traced callers get a client-side RPC span here; the peer's
-        # server-side subtree grafts under the SAME span when the
-        # transport pops _trace_spans (rpc/transport.py), so wire time
-        # vs remote disk time separate cleanly in the stitched trace.
-        with TRACER.span(f"rpc.storage.{method}",
-                         endpoint=getattr(self.client, "endpoint",
-                                          lambda: "?")(),
-                         disk=self.disk_path):
-            return self.client.call("storage", method, a, payload)
+        t0 = _time.perf_counter()
+        err = None
+        try:
+            if current_span() is None:  # untraced fast path: no tags
+                return self.client.call("storage", method, a, payload)
+            # Traced callers get a client-side RPC span here; the
+            # peer's server-side subtree grafts under the SAME span
+            # when the transport pops _trace_spans (rpc/transport.py),
+            # so wire time vs remote disk time separate cleanly in the
+            # stitched trace.
+            with TRACER.span(f"rpc.storage.{method}",
+                             endpoint=getattr(self.client, "endpoint",
+                                              lambda: "?")(),
+                             disk=self.disk_path):
+                return self.client.call("storage", method, a, payload)
+        except BaseException as e:
+            err = e
+            raise
+        finally:
+            DRIVEMON.record(self._drive_key(), method,
+                            (_time.perf_counter() - t0) * 1e3,
+                            error=is_drive_fault(err))
 
     def endpoint(self) -> str:
         return f"{self.client.endpoint()}{self.disk_path}"

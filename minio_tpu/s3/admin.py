@@ -463,15 +463,25 @@ class AdminHandlers:
         from ..utils.profiler import SamplingProfiler
         if getattr(self, "_profiler", None) is not None:
             raise ValueError("profiling already running")
-        self._profiler = SamplingProfiler(
+        prof = SamplingProfiler(
             interval=float(p.get("intervalMs", "5")) / 1000.0)
-        self._profiler.start()
+        prof.start()
+        self._profiler = prof
         out = {"ok": True}
         notif = self.server.notification
         if p.get("cluster") == "true" and notif is not None:
             # Cluster-wide profiling (ref peerRESTMethodStartProfiling).
-            out["peers"] = notif.profiling_start_all(
-                float(p.get("intervalMs", "5")))
+            # A raising fan-out must not strand the local profiler in a
+            # stuck "profiling already running" state: per-peer errors
+            # degrade inside profiling_start_all, so anything RAISING
+            # here is a caller-side fault — undo the local start.
+            try:
+                out["peers"] = notif.profiling_start_all(
+                    float(p.get("intervalMs", "5")))
+            except BaseException:
+                prof.stop()
+                self._profiler = None
+                raise
         return out
 
     def h_profiling_stop(self, p, body):
@@ -603,7 +613,37 @@ class AdminHandlers:
             return {"configured": False}
         return {"configured": True, "endpoint": a.endpoint,
                 "sent": a.sent, "failed": a.failed,
-                "dropped": a.dropped}
+                "dropped": a.dropped,
+                "queued": a.queued() if hasattr(a, "queued") else 0}
+
+    # -- slow-request log (obs/slowlog.py) ------------------------------
+
+    def h_slowlog(self, p, body):
+        """Tail the slow-request capture ring, filtered by blamed
+        layer (`blame=disk`) and/or API class or name (`api=write`,
+        `api=PUT-object`). Each entry carries the request's full span
+        tree, its QoS admission/deadline data, and the per-layer blame
+        breakdown — plus the last profile-on-slow burst when one ran."""
+        from ..obs.slowlog import SLOWLOG
+        # Clamp below too: n=0 would slice [-0:] (the whole ring) and
+        # negative n an oldest-first head slice.
+        n = min(max(1, int(p.get("n", "50") or 50)), SLOWLOG.RING_SIZE)
+        out = {
+            "entries": SLOWLOG.entries(n=n, blame=p.get("blame", ""),
+                                       api=p.get("api", "")),
+            "total": SLOWLOG.total,
+            "thresholdsMs": SLOWLOG.thresholds(),
+            "profileOnSlow": SLOWLOG.profile_on_slow,
+        }
+        if SLOWLOG.last_profile is not None:
+            out["profile"] = SLOWLOG.last_profile
+        return out
+
+    def h_drive_health(self, p, body):
+        """Admin view of the drive-health monitor (same payload as the
+        unauthenticated /minio-tpu/v2/health/drives node endpoint)."""
+        from ..obs.drivemon import DRIVEMON
+        return DRIVEMON.snapshot()
 
     # -- locks ----------------------------------------------------------
 

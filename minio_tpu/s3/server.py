@@ -126,6 +126,14 @@ class S3Request:
         self.bucket = parts[0] if parts[0] else ""
         self.key = parts[1] if len(parts) > 1 else ""
         self.request_id = uuid.uuid4().hex[:16].upper()
+        # QoS/slowlog annotations, stamped by route_qos: admission
+        # class, measured queue wait, opened budget, and whether this
+        # request was DELIBERATE backpressure (shed / burnt deadline)
+        # — exempt from slow-request capture by design.
+        self.qos_class = ""
+        self.qos_wait_ms = 0.0
+        self.qos_deadline_s = 0.0
+        self.slowlog_exempt = False
 
 
 class S3Response:
@@ -2224,6 +2232,23 @@ class S3Server:
                 if urlparse(ep).scheme not in ("http", "https"):
                     raise ValueError(f"audit endpoint {ep!r} must be "
                                      "http(s)")
+        if subsys == "obs":
+            for key, v in kvs.items():
+                if key.startswith("slow_ms"):
+                    if v.strip() == "":
+                        continue  # empty = inherit the default SLO
+                    try:
+                        if float(v) < 0:
+                            raise ValueError
+                    except ValueError:
+                        raise ValueError(
+                            f"obs {key}={v!r}: must be a millisecond "
+                            "number >= 0 (or empty to inherit)")
+                elif key == "profile_on_slow":
+                    if v not in ("on", "off"):
+                        raise ValueError(
+                            f"obs profile_on_slow={v!r}: must be "
+                            "on/off")
         if subsys == "api":
             from ..qos.deadline import parse_duration
             for key, v in kvs.items():
@@ -2280,6 +2305,32 @@ class S3Server:
             from ..logger import Logger
             Logger.get().log_once(
                 f"api qos config invalid, keeping previous: {e}", "config")
+        # Slowlog SLO thresholds reload live (the always-on tail
+        # capture must be tunable under fire, like the QoS caps).
+        from ..obs.slowlog import SLOWLOG
+
+        def _ms(key: str) -> float | None:
+            raw = cfg.get("obs", key).strip()
+            return float(raw) if raw else None
+
+        try:
+            # Empty default = inherit the shipped SLO, matching the
+            # validator's contract (an operator CLEARING the key must
+            # not silently disable capture; "0" does that explicitly).
+            default_ms = _ms("slow_ms")
+            if default_ms is None:
+                from ..config.kv import DEFAULT_KVS
+                default_ms = float(DEFAULT_KVS["obs"]["slow_ms"])
+            SLOWLOG.configure(
+                default_ms,
+                {c: _ms(f"slow_ms_{c}")
+                 for c in ("read", "write", "list", "admin")},
+                cfg.get("obs", "profile_on_slow") == "on")
+        except ValueError as e:  # env override may carry garbage
+            from ..logger import Logger
+            Logger.get().log_once(
+                f"obs slowlog config invalid, keeping previous: {e}",
+                "config")
         ep = cfg.get("audit_webhook", "endpoint")
         tok = cfg.get("audit_webhook", "auth_token")
         if cfg.get("audit_webhook", "enable") == "on" and ep:
@@ -2550,13 +2601,20 @@ class S3Server:
         from ..qos import admission as adm
         from ..qos import deadline as dl
         api_class = adm.classify(req.method, req.bucket, req.key)
+        req.qos_class = api_class
         budget_s = self.qos.deadline_s if self.qos.engaged else 0.0
+        req.qos_deadline_s = budget_s
         with dl.open_deadline(budget_s) as budget:
+            _t_adm = time.perf_counter()
             try:
                 admitted = self.qos.acquire(api_class, budget)
             except adm.AdmissionShed as shed:
+                # Deliberate backpressure: the QoS layer WORKING must
+                # not flood the slow-request log's blame histogram.
+                req.slowlog_exempt = True
                 raise s3err.ERR_SLOW_DOWN.with_retry_after(
                     shed.retry_after)
+            req.qos_wait_ms = (time.perf_counter() - _t_adm) * 1e3
             try:
                 resp = self.route(req)
             except BaseException:
@@ -2727,6 +2785,17 @@ class S3Server:
             return 200, "text/plain; version=0.0.4", text.encode()
         if raw_path == "/minio-tpu/v2/metrics/cluster":
             return self._metrics_cluster()
+        if raw_path == "/minio-tpu/v2/health/drives":
+            # Node drive health: the drivemon's per-drive EWMAs +
+            # suspect/faulty states (ref the drive sections of
+            # `mc admin obd`; here continuously tracked, not probed).
+            # UNAUTHENTICATED like the metrics pages, so endpoints are
+            # redacted — full paths are on the admin /drive-health.
+            from ..obs.drivemon import DRIVEMON, redact_drives
+            return 200, "application/json", _json.dumps(
+                redact_drives(DRIVEMON.snapshot())).encode()
+        if raw_path == "/minio-tpu/v2/health/cluster/drives":
+            return self._health_cluster_drives()
         if raw_path in ("/minio-tpu/console", "/minio-tpu/console/") \
                 and method == "GET":
             from .console import console_response
@@ -2770,12 +2839,15 @@ class S3Server:
                       status: int, duration_ms: float, rx: int, tx: int,
                       request_id: str = "", remote: str = "",
                       access_key: str = "", spans: dict | None = None,
+                      qos_class: str = "", blamed_layer: str = "",
                       ) -> None:
         """Fan a per-request trace entry to subscribers + the audit
         sink (ref httpTraceAll wrapper, cmd/handler-utils.go:349, and
         the AuditLog call in the same wrapper). `spans` carries the
         request's completed span tree, so `mc admin trace` consumers
-        get the per-layer breakdown alongside the flat entry."""
+        get the per-layer breakdown alongside the flat entry;
+        qos_class/blamed_layer ride into the audit entry so the
+        webhook stream joins against the slow-request log."""
         if self.trace_hub.subscriber_count:
             entry = {
                 "time": time.time(), "api": api, "method": method,
@@ -2792,7 +2864,8 @@ class S3Server:
             self.audit.send(audit_entry(
                 api, method, path, status, duration_ms, rx, tx,
                 access_key=access_key, request_id=request_id,
-                remote=remote))
+                remote=remote, qos_class=qos_class,
+                blamed_layer=blamed_layer))
 
     # One cluster scrape may fan out to every peer; cache it so an
     # unauthenticated GET loop cannot amplify into N internal RPCs per
@@ -2800,34 +2873,88 @@ class S3Server:
     CLUSTER_METRICS_TTL = 10.0
     _cluster_metrics_cache: tuple[float, bytes] | None = None
 
+    def _cached_cluster_scrape(self, cache_attr: str, build) -> bytes:
+        """Shared anti-amplification TTL cache for cluster fan-in
+        endpoints (metrics2, drive health): build() runs the peer
+        fan-out at most once per CLUSTER_METRICS_TTL."""
+        cached = getattr(self, cache_attr)
+        if cached is not None and \
+                time.monotonic() - cached[0] < self.CLUSTER_METRICS_TTL:
+            return cached[1]
+        body = build()
+        setattr(self, cache_attr, (time.monotonic(), body))
+        return body
+
     def _metrics_cluster(self) -> tuple[int, str, bytes]:
         """Metrics v2, cluster scope: this node's snapshot merged with
         every peer's (scraped over the `metrics2` peer RPC) — the
         node/cluster split of cmd/metrics-v2.go. Unreachable peers
         degrade the node count, never the scrape."""
         from ..obs import metrics2 as m2
-        cached = self._cluster_metrics_cache
-        if cached is not None and \
-                time.monotonic() - cached[0] < self.CLUSTER_METRICS_TTL:
-            return 200, "text/plain; version=0.0.4", cached[1]
-        snaps = [m2.METRICS2.snapshot()]
-        nodes = 1
-        if self.notification is not None:
-            for res in self.notification.metrics2_all().values():
-                snap = res.get("metrics2") if isinstance(res, dict) \
-                    else None
-                if snap is not None:
-                    snaps.append(snap)
-                    nodes += 1
-        merged = m2.merge(*snaps)
-        merged["minio_tpu_v2_cluster_nodes"] = {
-            "type": "gauge",
-            "help": "Nodes contributing to a cluster metrics scrape.",
-            "buckets": None,
-            "series": [{"labels": {}, "value": nodes}]}
-        body = m2.render(merged).encode()
-        self._cluster_metrics_cache = (time.monotonic(), body)
+
+        def build() -> bytes:
+            snaps = [m2.METRICS2.snapshot()]
+            nodes = 1
+            if self.notification is not None:
+                for res in self.notification.metrics2_all().values():
+                    snap = res.get("metrics2") if isinstance(res, dict) \
+                        else None
+                    if snap is not None:
+                        snaps.append(snap)
+                        nodes += 1
+            merged = m2.merge(*snaps)
+            merged["minio_tpu_v2_cluster_nodes"] = {
+                "type": "gauge",
+                "help": "Nodes contributing to a cluster metrics scrape.",
+                "buckets": None,
+                "series": [{"labels": {}, "value": nodes}]}
+            return m2.render(merged).encode()
+
+        body = self._cached_cluster_scrape("_cluster_metrics_cache",
+                                           build)
         return 200, "text/plain; version=0.0.4", body
+
+    _cluster_drives_cache: tuple[float, bytes] | None = None
+
+    def _health_cluster_drives(self) -> tuple[int, str, bytes]:
+        """Cluster drive health: this node's drivemon snapshot merged
+        with every peer's (scraped over the `drivemon` peer RPC),
+        exactly like the metrics2 fan-in — each drive annotated with
+        the node it was observed from. Unreachable peers degrade the
+        node count, never the scrape."""
+        import json as _json
+        from ..obs.drivemon import DRIVEMON, redact_drives
+
+        def build() -> bytes:
+            local = DRIVEMON.snapshot()
+            drives = [dict(d, node="local") for d in local["drives"]]
+            nodes = 1
+            if self.notification is not None:
+                for i, (key, res) in enumerate(
+                        sorted(self.notification.drivemon_all()
+                               .items())):
+                    snap = res.get("drivemon") if isinstance(res, dict) \
+                        else None
+                    if snap is None:
+                        continue
+                    nodes += 1
+                    for d in snap.get("drives", []):
+                        if isinstance(d, dict):
+                            # Anonymous surface: a stable ordinal, not
+                            # the peer's internal host:port.
+                            drives.append(dict(d, node=f"peer{i}"))
+            return _json.dumps(redact_drives({
+                "nodes": nodes,
+                "drives": drives,
+                "suspect": sum(1 for d in drives
+                               if d.get("state") == "suspect"),
+                "faulty": sum(1 for d in drives
+                              if d.get("state") == "faulty"),
+            })).encode()
+
+        body = self._cached_cluster_scrape("_cluster_drives_cache",
+                                           build)
+        return 200, "application/json", body
 
     def _cluster_healthy(self) -> bool:
         """Quorum-aware cluster check (ref ClusterCheckHandler,
@@ -3115,6 +3242,10 @@ class S3Server:
                         Logger.get().log_once(
                             f"{self.command} {raw_path}: quorum: {e}",
                             "s3-handler")
+                        if isinstance(e, DeadlineExceeded):
+                            # Burnt budget = deliberate backpressure,
+                            # exempt from slowlog like admission sheds.
+                            req.slowlog_exempt = True
                         err = (s3err.ERR_REQUEST_TIMEOUT
                                if isinstance(e, DeadlineExceeded)
                                else s3err.ERR_SLOW_DOWN
@@ -3195,13 +3326,32 @@ class S3Server:
                                 None, resp_len)
                         server.bandwidth.record(req.bucket, length,
                                                 resp_len)
+                        # Slow-request capture: over-SLO or 5xx lands
+                        # the full span tree + QoS data in the slowlog
+                        # ring, annotated with the blamed layer
+                        # (obs/slowlog.py). Sheds/burnt deadlines are
+                        # exempt (deliberate backpressure).
+                        from ..obs.slowlog import SLOWLOG
+                        slow_entry = SLOWLOG.record(
+                            api=api, api_class=req.qos_class,
+                            method=self.command, path=raw_path,
+                            status=resp.status, duration_ms=dur_ms,
+                            request_id=req.request_id,
+                            trace=trace_tree,
+                            qos={"class": req.qos_class,
+                                 "waitMs": round(req.qos_wait_ms, 3),
+                                 "deadlineS": req.qos_deadline_s},
+                            exempt=req.slowlog_exempt)
                         server.publish_trace(
                             api, self.command, raw_path, resp.status,
                             dur_ms, length,
                             resp_len, req.request_id,
                             self.client_address[0],
                             getattr(req, "access_key", ""),
-                            spans=trace_tree)
+                            spans=trace_tree,
+                            qos_class=req.qos_class,
+                            blamed_layer=(slow_entry["blamedLayer"]
+                                          if slow_entry else ""))
 
                     finish_fn = _finish_request
                     if not body_is_stream:

@@ -23,33 +23,44 @@ from . import errors as serr
 from .interface import StorageAPI
 from .metadata import XL_META_FILE, FileInfo, XLMeta
 from ..erasure import bitrot
+from ..obs.drivemon import DRIVEMON, is_drive_fault
 from ..obs.metrics2 import METRICS2
 from ..obs.span import TRACER
 
 
 class _DiskOp:
     """Per-disk-call instrumentation: a child span on the active trace
-    (no-op when untraced) plus the metrics-v2 disk-op histogram — the
+    (no-op when untraced), the metrics-v2 disk-op histogram, AND the
+    drive-health monitor's per-drive latency/error accounting — the
     per-disk attribution layer of the request trace (the reference's
     storage layer exports xl_storage api latencies the same way in
-    cmd/metrics-v2.go)."""
+    cmd/metrics-v2.go; per-drive health in pkg/smart / admin obd)."""
 
-    __slots__ = ("op", "_cm", "_t0")
+    __slots__ = ("op", "_cm", "_t0", "_disk")
 
-    def __init__(self, op: str, root: str):
+    def __init__(self, op: str, disk: "XLStorage"):
         self.op = op
-        self._cm = TRACER.span("disk." + op, disk=root)
+        self._disk = disk
+        self._cm = TRACER.span("disk." + op, disk=disk.root)
 
     def __enter__(self):
         self._t0 = time.perf_counter()
         self._cm.__enter__()
+        # Fault-injection hook (tests/fault harness): a latency-
+        # wrapping shim sets fault_latency_s so the injected delay
+        # lands INSIDE the measured op window — exactly what a slow
+        # physical drive looks like to the monitor.
+        if self._disk.fault_latency_s:
+            time.sleep(self._disk.fault_latency_s)
         return self
 
     def __exit__(self, *exc):
         self._cm.__exit__(*exc)
+        ms = (time.perf_counter() - self._t0) * 1e3
         METRICS2.observe("minio_tpu_v2_disk_op_duration_ms",
-                         {"op": self.op},
-                         (time.perf_counter() - self._t0) * 1e3)
+                         {"op": self.op}, ms)
+        DRIVEMON.record(self._disk.root, self.op, ms,
+                        error=bool(exc) and is_drive_fault(exc[0]))
         return False
 
 MINIO_META_BUCKET = ".minio.sys"
@@ -67,6 +78,10 @@ def _is_valid_volume(volume: str) -> bool:
 
 
 class XLStorage(StorageAPI):
+    # Injected per-op latency (seconds) applied inside _DiskOp's
+    # measured window — the fault harness's slow-drive shim knob.
+    fault_latency_s = 0.0
+
     def __init__(self, root: str):
         self.root = os.path.abspath(root)
         self.disk_id = ""
@@ -104,7 +119,8 @@ class XLStorage(StorageAPI):
     # --- identity / health ---
 
     def disk_info(self) -> dict:
-        st = os.statvfs(self.root)
+        with _DiskOp("disk_info", self):
+            st = os.statvfs(self.root)
         return {
             "total": st.f_blocks * st.f_frsize,
             "free": st.f_bavail * st.f_frsize,
@@ -141,8 +157,9 @@ class XLStorage(StorageAPI):
         return out
 
     def stat_volume(self, volume: str) -> dict:
-        p = self._check_vol(volume)
-        st = os.stat(p)
+        with _DiskOp("stat_volume", self):
+            p = self._check_vol(volume)
+            st = os.stat(p)
         return {"name": volume, "created": st.st_mtime}
 
     def delete_volume(self, volume: str, force: bool = False) -> None:
@@ -238,7 +255,7 @@ class XLStorage(StorageAPI):
 
     def write_all(self, volume: str, path: str, data: bytes) -> None:
         # Volume check happens in _makedirs_for, adjacent to the mkdir.
-        with _DiskOp("write_all", self.root):
+        with _DiskOp("write_all", self):
             self._atomic_write(self._file_path(volume, path),
                                bytes(data), volume=volume)
 
@@ -246,7 +263,7 @@ class XLStorage(StorageAPI):
         self._check_vol(volume)
         full = self._file_path(volume, path)
         try:
-            with _DiskOp("read_all", self.root), open(full, "rb") as f:
+            with _DiskOp("read_all", self), open(full, "rb") as f:
                 return f.read()
         except FileNotFoundError:
             raise serr.FileNotFound(f"{volume}/{path}")
@@ -260,7 +277,7 @@ class XLStorage(StorageAPI):
         self._check_vol(volume)
         full = self._file_path(volume, path)
         try:
-            with _DiskOp("read_file", self.root), open(full, "rb") as f:
+            with _DiskOp("read_file", self), open(full, "rb") as f:
                 f.seek(offset)
                 return f.read(length)
         except FileNotFoundError:
@@ -277,7 +294,8 @@ class XLStorage(StorageAPI):
         (Volume check happens in _makedirs_for, adjacent to mkdir.)"""
         full = self._file_path(volume, path)
         if isinstance(data, (bytes, bytearray, memoryview)):
-            self._atomic_write(full, bytes(data), volume=volume)
+            with _DiskOp("create_file", self):
+                self._atomic_write(full, bytes(data), volume=volume)
             return
         self._makedirs_for(volume, os.path.dirname(full))
         try:
@@ -292,7 +310,7 @@ class XLStorage(StorageAPI):
     def append_file(self, volume: str, path: str, data: bytes) -> None:
         full = self._file_path(volume, path)
         try:
-            with _DiskOp("append_file", self.root):
+            with _DiskOp("append_file", self):
                 try:
                     f = open(full, "ab")
                 except FileNotFoundError:
@@ -316,13 +334,14 @@ class XLStorage(StorageAPI):
         self._check_vol(volume)
         full = self._file_path(volume, path)
         try:
-            if os.path.isdir(full):
-                if recursive:
-                    shutil.rmtree(full)
+            with _DiskOp("delete", self):
+                if os.path.isdir(full):
+                    if recursive:
+                        shutil.rmtree(full)
+                    else:
+                        os.rmdir(full)
                 else:
-                    os.rmdir(full)
-            else:
-                os.remove(full)
+                    os.remove(full)
         except FileNotFoundError:
             raise serr.FileNotFound(f"{volume}/{path}")
         except OSError as e:
@@ -354,7 +373,7 @@ class XLStorage(StorageAPI):
         self._makedirs_for(dst_volume, os.path.dirname(dst))
         tmp = os.path.join(self.root, TMP_DIR, str(uuid.uuid4()))
         try:
-            with _DiskOp("link_file", self.root):
+            with _DiskOp("link_file", self):
                 # link to a tmp name then replace: os.link alone fails
                 # EEXIST on a dst left by a retried complete.
                 try:
@@ -419,7 +438,7 @@ class XLStorage(StorageAPI):
                     dst_volume: str, dst_path: str) -> None:
         """Commit: move <src>/<dataDir> under dst object dir, then merge
         fi as a version into dst xl.meta (ref cmd/xl-storage.go:1972)."""
-        with _DiskOp("rename_data", self.root):
+        with _DiskOp("rename_data", self):
             self._rename_data(src_volume, src_path, fi, dst_volume,
                               dst_path)
 

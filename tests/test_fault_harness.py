@@ -384,3 +384,61 @@ def test_hot_single_drive_swap_heals_without_restart(cluster):
         for k, b in bodies.items():
             g = ci.get_object("fault-swap", k)
             assert g.status == 200 and g.body == b, (i, k)
+
+
+def test_slow_disk_flagged_suspect_and_put_blamed_disk(tmp_path):
+    """Slow-drive injection (the dominant large-array failure mode,
+    arXiv:1709.05365): a latency-wrapping XLStorage shim drags ONE
+    disk of a 4+2 set. Within a bounded number of ops the drivemon
+    must flag exactly that disk as suspect (peers stay ok), and a PUT
+    over the degraded set must land a slowlog entry blamed on `disk`
+    — the two answers this PR exists to give operators."""
+    from minio_tpu.erasure.engine import ErasureObjects
+    from minio_tpu.obs.drivemon import DRIVEMON
+    from minio_tpu.obs.slowlog import SLOWLOG
+    from minio_tpu.s3.server import S3Server
+    from minio_tpu.storage.xl import XLStorage
+
+    class SlowDisk(XLStorage):
+        """Latency-wrapping shim: every storage op pays the injected
+        delay INSIDE the measured _DiskOp window, exactly like a
+        degraded physical drive."""
+        fault_latency_s = 0.025
+
+    roots = [str(tmp_path / f"d{i}") for i in range(6)]
+    disks = [XLStorage(r) for r in roots[:5]] + [SlowDisk(roots[5])]
+    slow_ep = disks[5].root
+    layer = ErasureObjects(disks, 4, 2, block_size=64 * 1024)
+    srv = S3Server(layer, ACCESS, SECRET)
+    port = srv.start()
+    try:
+        srv.config.set_kv("obs slow_ms=1")  # capture every request
+        c = S3Client("127.0.0.1", port, ACCESS, SECRET)
+        assert c.make_bucket("slowdisk").status == 200
+        body = os.urandom(150_000)
+        # Bounded op budget: ~3 recorded ops per disk per PUT, window
+        # = 16 ops, suspect needs 2 consecutive outlier windows after
+        # the first EWMA window -> well within 24 PUTs.
+        n_puts = 24
+        for i in range(n_puts):
+            _put_ok(c, "slowdisk", f"k{i}", body)
+            if DRIVEMON.state_of(slow_ep) == "suspect":
+                break
+        snap = DRIVEMON.snapshot()
+        states = {d["endpoint"]: d["state"] for d in snap["drives"]
+                  if d["endpoint"] in set(map(os.path.abspath, roots))}
+        assert states[slow_ep] == "suspect", snap
+        others = {e: s for e, s in states.items() if e != slow_ep}
+        assert len(others) == 5 and all(
+            s == "ok" for s in others.values()), states
+        # The degraded PUT's slowlog capture blames the disk layer.
+        entries = [e for e in SLOWLOG.entries(SLOWLOG.RING_SIZE)
+                   if e["path"].startswith("/slowdisk/")
+                   and e["api"] == "PUT-object"]
+        assert entries, "no slowlog capture for the degraded PUTs"
+        assert entries[-1]["blamedLayer"] == "disk", entries[-1]
+        assert entries[-1]["spans"]["traceId"] == \
+            entries[-1]["requestID"]
+    finally:
+        srv.stop()
+        SLOWLOG.configure(1000.0, {}, False)

@@ -531,6 +531,397 @@ def test_obs_lint_clean():
     assert lint.main() == 0
 
 
+def test_obs_lint_rule5_catches_bad_calls(tmp_path):
+    """Rule 5 flags dynamic and unregistered names in drivemon/slowlog
+    recording calls (the unit the rule checks is the CALL, so rule 2's
+    literal scan can't substitute)."""
+    import tools.obs_lint as lint
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "METRICS2.inc(name)\n"
+        "METRICS2.observe('minio_tpu_v2_not_registered_xx', None, 1)\n"
+        "METRICS2.set_gauge('minio_tpu_v2_drive_state', None, 1)\n")
+    v = lint._check_literal_metric_calls([str(bad)], "drivemon/slowlog")
+    assert len(v) == 2  # line 3 is literal AND registered
+    assert any("literal" in x for x in v)
+    assert any("not registered" in x for x in v)
+    # And the wired rule itself is clean on the real tree.
+    assert lint.check_drivemon_slowlog_metric_calls() == []
+
+
+# ---------------------------------------------------------------------------
+# Drive-health monitor (obs/drivemon.py)
+
+from minio_tpu.obs.drivemon import DRIVEMON, DriveMonitor, is_drive_fault
+
+
+def _fill_windows(mon, eps, slow_ep, windows, slow_ms=60.0, fast_ms=1.0):
+    for _ in range(windows * mon.WINDOW_OPS):
+        for ep in eps:
+            mon.record(ep, "read_file",
+                       slow_ms if ep == slow_ep else fast_ms)
+
+
+def test_drivemon_flags_peer_relative_outlier():
+    """One drive consistently k-times slower than its set peers goes
+    suspect after SUSPECT_WINDOWS windows; the peers stay ok."""
+    mon = DriveMonitor()
+    eps = [f"/dmtest/a/d{i}" for i in range(4)]
+    mon.register_set(eps)
+    _fill_windows(mon, eps, eps[0], mon.SUSPECT_WINDOWS + 1)
+    snap = mon.snapshot()
+    states = {d["endpoint"]: d["state"] for d in snap["drives"]}
+    assert states[eps[0]] == "suspect"
+    assert all(states[e] == "ok" for e in eps[1:])
+    assert snap["suspect"] == 1 and snap["faulty"] == 0
+    # Latency attribution is per op class.
+    assert mon.ewma_for(eps[0])["read"] > \
+        3 * mon.ewma_for(eps[1])["read"]
+
+
+def test_drivemon_recovers_when_latency_normalizes():
+    mon = DriveMonitor()
+    eps = [f"/dmtest/b/d{i}" for i in range(4)]
+    mon.register_set(eps)
+    _fill_windows(mon, eps, eps[0], mon.SUSPECT_WINDOWS + 1)
+    assert mon.state_of(eps[0]) == "suspect"
+    # Drive replaced / contention gone: healthy windows decay the
+    # EWMA back under OUTLIER_K x the peer median and the state clears
+    # (alpha=0.3 -> ~10 windows to fall from 60x to <3x).
+    _fill_windows(mon, eps, slow_ep=None, windows=14)
+    assert mon.state_of(eps[0]) == "ok"
+
+
+def test_drivemon_faulty_on_sustained_errors():
+    mon = DriveMonitor()
+    eps = [f"/dmtest/c/d{i}" for i in range(3)]
+    mon.register_set(eps)
+    for _ in range(mon.FAULTY_WINDOWS * mon.WINDOW_OPS):
+        mon.record(eps[0], "write_all", 1.0, error=True)
+        for ep in eps[1:]:
+            mon.record(ep, "write_all", 1.0)
+    assert mon.state_of(eps[0]) == "faulty"
+    assert all(mon.state_of(e) == "ok" for e in eps[1:])
+    # Transition counters landed in metrics2 under the REDACTED drive
+    # identity (the metrics pages are unauthenticated surfaces).
+    from minio_tpu.obs.drivemon import redacted_endpoint
+    red = redacted_endpoint(eps[0])
+    assert m2.METRICS2.get("minio_tpu_v2_drive_state_transitions_total",
+                           {"disk": red, "state": "faulty"}) >= 1
+    assert m2.METRICS2.get("minio_tpu_v2_drive_state",
+                           {"disk": red}) == 2
+
+
+def test_drivemon_dominance_shields_starved_bystander():
+    """While a genuinely slow drive exists, a moderately-elevated
+    healthy drive (scheduler starvation on a loaded host) must NOT
+    co-flag: a suspect has to dominate the WORST peer, and the real
+    laggard owns that slot."""
+    mon = DriveMonitor()
+    eps = [f"/dmtest/dom/d{i}" for i in range(5)]
+    mon.register_set(eps)
+    lat = {eps[0]: 60.0,   # the real laggard
+           eps[1]: 20.0}   # starved bystander: 20x the median, but
+    for _ in range(4 * mon.WINDOW_OPS):  # not 1.5x the laggard
+        for ep in eps:
+            mon.record(ep, "read_file", lat.get(ep, 1.0))
+    assert mon.state_of(eps[0]) == "suspect"
+    assert mon.state_of(eps[1]) == "ok"
+    assert all(mon.state_of(e) == "ok" for e in eps[2:])
+
+
+def test_drivemon_lone_drive_never_suspect():
+    """No peers -> no outlier scoring (a single-drive group has no one
+    to be slow relative to)."""
+    mon = DriveMonitor()
+    for _ in range(6 * mon.WINDOW_OPS):
+        mon.record("/dmtest/lone", "read_all", 500.0)
+    assert mon.state_of("/dmtest/lone") == "ok"
+
+
+def test_drivemon_benign_errors_do_not_count():
+    from minio_tpu.storage import errors as serr
+    assert not is_drive_fault(serr.FileNotFound("x"))
+    assert not is_drive_fault(serr.VolumeNotFound)
+    assert not is_drive_fault(FileNotFoundError("x"))
+    assert not is_drive_fault(None)
+    assert is_drive_fault(serr.FaultyDisk("io error"))
+    assert is_drive_fault(OSError("io"))
+
+
+def test_drivemon_records_through_real_disk_ops(tmp_path):
+    """The storage _DiskOp boundary feeds the monitor: real engine
+    traffic shows up under the disks' endpoints."""
+    eng = _engine(tmp_path / "dm")
+    eng.make_bucket("b")
+    eng.put_object("b", "k", b"d" * 50_000)
+    eng.get_object("b", "k")
+    snap = DRIVEMON.snapshot()
+    mine = [d for d in snap["drives"]
+            if d["endpoint"].startswith(str(tmp_path / "dm"))]
+    assert len(mine) == 4
+    assert all(d["opsTotal"] > 0 for d in mine)
+    # All four disks of the set share one peer group.
+    assert len({d["set"] for d in mine}) == 1
+
+
+def test_drives_health_endpoints_node_and_cluster(tmp_path):
+    """/minio-tpu/v2/health/drives serves the node snapshot; the
+    cluster variant fan-in merges peers exactly like metrics2."""
+    from minio_tpu.rpc.cluster import derive_cluster_key
+    from minio_tpu.rpc.peer import NotificationSys, PeerRPCService
+    from minio_tpu.rpc.transport import RPCClient, RPCRegistry
+
+    eng = _engine(tmp_path / "hd")
+    eng.make_bucket("b")
+    eng.put_object("b", "k", b"h" * 30_000)
+
+    key = derive_cluster_key(ACCESS, SECRET)
+    reg1 = RPCRegistry(key)
+    reg1.register("peer", PeerRPCService("topo"))
+    srv1 = S3Server(None, ACCESS, SECRET, rpc_registry=reg1)
+    port1 = srv1.start()
+    srv0 = S3Server(None, ACCESS, SECRET)
+    srv0.notification = NotificationSys(
+        {f"127.0.0.1:{port1}": RPCClient("127.0.0.1", port1, key)})
+    port0 = srv0.start()
+    try:
+        from minio_tpu.obs.drivemon import redacted_endpoint
+        status, ctype, body = _http_get(port0,
+                                        "/minio-tpu/v2/health/drives")
+        assert status == 200 and ctype.startswith("application/json")
+        node = json.loads(body)
+        eps = {d["endpoint"] for d in node["drives"]}
+        # The unauthenticated surface serves REDACTED identities —
+        # never the absolute on-disk paths.
+        assert not any(e.startswith(str(tmp_path)) for e in eps)
+        assert redacted_endpoint(str(tmp_path / "hd" / "d0")) in eps
+        assert {"suspect", "faulty"} <= set(node)
+
+        status, _, body = _http_get(
+            port0, "/minio-tpu/v2/health/cluster/drives")
+        assert status == 200
+        cluster = json.loads(body)
+        assert cluster["nodes"] == 2
+        # Every drive row is annotated with the node it came from
+        # (peers as stable ordinals, not internal host:port).
+        assert all("node" in d for d in cluster["drives"])
+        assert any(d["node"] == "local" for d in cluster["drives"])
+        assert not any(":" in d["node"] for d in cluster["drives"])
+        # The authenticated admin route keeps the full endpoints.
+        full = srv0.admin.h_drive_health({}, b"")
+        assert any(d["endpoint"].startswith(str(tmp_path / "hd"))
+                   for d in full["drives"])
+    finally:
+        srv0.stop()
+        srv1.stop()
+
+
+# ---------------------------------------------------------------------------
+# Slow-request log (obs/slowlog.py)
+
+from minio_tpu.obs.slowlog import SLOWLOG, SlowLog, blame_layers, \
+    blamed_layer
+
+
+def test_blame_attribution_self_times():
+    tree = {
+        "name": "PUT-object", "durationMs": 100.0,
+        "children": [
+            {"name": "auth.sigv4", "durationMs": 2.0},
+            {"name": "ec.encode", "durationMs": 10.0, "children": [
+                {"name": "kernel.rs_encode", "durationMs": 8.0}]},
+            {"name": "ec.write", "durationMs": 70.0, "children": [
+                {"name": "ec.shard_write", "durationMs": 65.0,
+                 "children": [
+                     {"name": "disk.append_file", "durationMs": 60.0}]},
+            ]},
+        ],
+    }
+    totals = blame_layers(tree, admission_wait_ms=3.0)
+    assert blamed_layer(totals) == "disk"
+    # disk = shard_write self (65-60) + disk.append self (60)
+    assert totals["disk"] == pytest.approx(65.0)
+    # encode-kernel = ec.encode self (2) + kernel self (8)
+    assert totals["encode-kernel"] == pytest.approx(10.0)
+    # client-stream = root self (18) MINUS the admission wait that
+    # elapsed inside the root (3) + auth (2) + ec.write self (5),
+    # the latter two inheriting the root's bucket.
+    assert totals["client-stream"] == pytest.approx(22.0)
+    assert totals["admission-wait"] == pytest.approx(3.0)
+    # rpc spans bucket as rpc, grafted remote disk work as disk.
+    rpc_tree = {"name": "GET-object", "durationMs": 50.0, "children": [
+        {"name": "rpc.storage.read_file", "durationMs": 45.0,
+         "children": [
+             {"name": "rpc.server.storage.read_file",
+              "durationMs": 20.0, "children": [
+                  {"name": "disk.read_file", "durationMs": 18.0}]}]}]}
+    t2 = blame_layers(rpc_tree)
+    assert t2["rpc"] == pytest.approx(45.0 - 20.0 + 2.0)
+    assert t2["disk"] == pytest.approx(18.0)
+    assert blamed_layer(t2) == "rpc"
+    # No trace at all -> other (unless admission wait dominates).
+    assert blamed_layer(blame_layers(None)) == "other"
+    assert blamed_layer(blame_layers(None, 5.0)) == "admission-wait"
+
+
+def test_slowlog_capture_rules():
+    sl = SlowLog()
+    sl.configure(100.0, {"write": 50.0}, False)
+    common = dict(api="GET-object", method="GET", path="/b/k",
+                  request_id="R1")
+    # Fast + 2xx: not captured.
+    assert sl.record(api_class="read", status=200, duration_ms=10.0,
+                     **common) is None
+    # Over the class SLO: captured, slow-flagged.
+    e = sl.record(api_class="write", status=200, duration_ms=60.0,
+                  **common)
+    assert e is not None and e["slow"] and e["thresholdMs"] == 50.0
+    # 5xx under the SLO: captured anyway.
+    e = sl.record(api_class="read", status=500, duration_ms=5.0,
+                  **common)
+    assert e is not None and not e["slow"]
+    # Deliberate backpressure: exempt even at 503 + slow.
+    assert sl.record(api_class="write", status=503, duration_ms=999.0,
+                     exempt=True, **common) is None
+    assert sl.total == 2
+    assert len(sl.entries(10)) == 2
+    # Filters.
+    assert len(sl.entries(10, api="write")) == 1
+    assert all(x["blamedLayer"] == "other"
+               for x in sl.entries(10, blame="other"))
+    # Ring bounded.
+    for i in range(sl.RING_SIZE + 40):
+        sl.record(api_class="read", status=500, duration_ms=1.0,
+                  api="GET-object", method="GET", path=f"/b/k{i}")
+    assert len(sl.entries(10_000)) == sl.RING_SIZE
+    assert sl.total == 2 + sl.RING_SIZE + 40
+
+
+def test_slowlog_qos_wait_blames_admission():
+    sl = SlowLog()
+    sl.configure(10.0, {}, False)
+    e = sl.record(api="PUT-object", api_class="write", method="PUT",
+                  path="/b/k", status=200, duration_ms=80.0,
+                  qos={"class": "write", "waitMs": 70.0,
+                       "deadlineS": 10.0})
+    assert e["blamedLayer"] == "admission-wait"
+    assert e["qos"]["waitMs"] == 70.0
+
+
+def test_slowlog_end_to_end_with_admin_endpoint(server, client):
+    """Full stack: a live-reloaded 1ms SLO captures a real PUT with
+    its span tree + blame; the admin /slowlog endpoint serves and
+    filters it; audit fields join against it."""
+    srv, _ = server
+    sent = []
+
+    class _AuditStub:
+        endpoint = "stub"
+        sent_n = failed = dropped = 0
+
+        def send(self, entry):
+            sent.append(entry)
+
+        def close(self):
+            pass
+
+    # Mark the stub env-configured so the set_kv apply hook (which
+    # tears down config-owned sinks when audit_webhook is off) keeps it.
+    old_audit, old_env = srv.audit, srv._audit_from_env
+    srv.audit, srv._audit_from_env = _AuditStub(), True
+    try:
+        srv.config.set_kv("obs slow_ms=1")
+        assert SLOWLOG.threshold_ms("write") == 1.0
+        client.make_bucket("slowlogb")
+        r = client.put_object("slowlogb", "s.txt", b"slow-capture")
+        assert r.status == 200
+        res = client.request("GET", "/minio-tpu/admin/v1/slowlog",
+                             query="api=write&n=50")
+        assert res.status == 200
+        doc = json.loads(res.body)
+        assert doc["thresholdsMs"]["default"] == 1.0
+        entry = next(e for e in doc["entries"]
+                     if e["path"] == "/slowlogb/s.txt")
+        assert entry["apiClass"] == "write" and entry["slow"]
+        assert entry["blamedLayer"] in (
+            "disk", "client-stream", "encode-kernel")
+        assert entry["spans"]["traceId"] == entry["requestID"]
+        assert entry["qos"]["class"] == "write"
+        # Blame filter excludes non-matching layers.
+        res = client.request("GET", "/minio-tpu/admin/v1/slowlog",
+                             query="blame=rpc")
+        assert all(e["blamedLayer"] == "rpc"
+                   for e in json.loads(res.body)["entries"])
+        # The blame histogram counted it.
+        total = m2.METRICS2.get(
+            "minio_tpu_v2_slow_requests_total",
+            {"class": "write", "blame": entry["blamedLayer"]})
+        assert total >= 1
+        # Audit satellite: the webhook entry carries the join keys.
+        audit = next(a for a in sent
+                     if a["api"]["path"] == "/slowlogb/s.txt")
+        assert audit["trace_id"] == entry["requestID"]
+        assert audit["qos_class"] == "write"
+        assert audit["blamed_layer"] == entry["blamedLayer"]
+    finally:
+        srv.config.set_kv("obs slow_ms=1000")
+        srv.audit, srv._audit_from_env = old_audit, old_env
+
+
+def test_slowlog_profile_on_slow_burst(monkeypatch):
+    sl = SlowLog()
+    monkeypatch.setattr(SlowLog, "PROFILE_BURST_S", 0.1)
+    sl.configure(1.0, {}, True)
+    for i in range(sl.PROFILE_TRIGGER):
+        sl.record(api="GET-object", api_class="read", method="GET",
+                  path=f"/b/p{i}", status=200, duration_ms=50.0)
+    deadline = time.time() + 5
+    while time.time() < deadline and sl.last_profile is None:
+        time.sleep(0.02)
+    assert sl.last_profile is not None
+    assert sl.last_profile["report"]["samples"] >= 0
+    assert "self" in sl.last_profile["report"]
+
+
+def test_audit_status_reports_queue_and_drops(server, client):
+    srv, _ = server
+    old = srv.audit
+    srv.audit = AuditWebhook("http://127.0.0.1:1/never", queue_size=1)
+    try:
+        r = client.request("GET", "/minio-tpu/admin/v1/audit-status")
+        doc = json.loads(r.body)
+        assert doc["configured"]
+        assert {"sent", "failed", "dropped", "queued"} <= set(doc)
+    finally:
+        srv.audit.close()
+        srv.audit = old
+
+
+def test_profiling_start_cleans_up_on_peer_fanout_failure(server):
+    """Satellite regression: a raising cluster fan-out must not leave
+    the local profiler stuck in 'profiling already running'."""
+    srv, _ = server
+
+    class BoomNotif:
+        def profiling_start_all(self, interval_ms):
+            raise RuntimeError("peer fan-out exploded")
+
+    old = srv.notification
+    srv.notification = BoomNotif()
+    try:
+        with pytest.raises(RuntimeError):
+            srv.admin.h_profiling_start({"cluster": "true"}, b"")
+        assert getattr(srv.admin, "_profiler", None) is None
+        # Not stuck: a plain start now succeeds and stops cleanly.
+        srv.notification = None
+        assert srv.admin.h_profiling_start({}, b"")["ok"]
+        out = srv.admin.h_profiling_stop({}, b"")
+        assert "profile" in out
+    finally:
+        srv.notification = old
+
+
 def test_phasetimer_feeds_metrics2():
     from minio_tpu.utils.phasetimer import PUT
     before = m2.METRICS2.get("minio_tpu_v2_put_phase_duration_ms",

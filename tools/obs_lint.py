@@ -1,7 +1,7 @@
 """Fast observability lint, wired into the tier-1 path
 (tests/test_observability.py runs main() and fails on any violation).
 
-Four invariants, all cheap AST walks:
+Five invariants, all cheap AST walks:
 
 1. No bare ``assert`` used for error handling in ``minio_tpu/native/``:
    a ``python -O`` run strips asserts, which would let a garbled native
@@ -23,6 +23,13 @@ Four invariants, all cheap AST walks:
 4. The same literal-registered-name bar for the data-plane pipeline's
    recordings (``minio_tpu/utils/pipeline.py``): the depth/stall
    series are how operators and bench.py detect lost overlap.
+
+5. The same bar again for the drive-health monitor and the
+   slow-request log (``minio_tpu/obs/drivemon.py``,
+   ``minio_tpu/obs/slowlog.py``): their state/blame series are the
+   operator-facing evidence for "which disk is slow" and "why was
+   this request slow" — a typoed or dynamically-built name there
+   silently blinds both questions.
 
 Run standalone: ``python -m tools.obs_lint``.
 """
@@ -150,12 +157,22 @@ def check_pipeline_metric_calls() -> list[str]:
         [os.path.join(PKG, "utils", "pipeline.py")], "pipeline")
 
 
+def check_drivemon_slowlog_metric_calls() -> list[str]:
+    """Rule 5: drivemon/slowlog recordings are the operator-facing
+    evidence for drive health and slow-request blame — every recording
+    call there must pass a literal, registered metric name."""
+    return _check_literal_metric_calls(
+        [os.path.join(PKG, "obs", "drivemon.py"),
+         os.path.join(PKG, "obs", "slowlog.py")], "drivemon/slowlog")
+
+
 def main() -> int:
     if REPO not in sys.path:
         sys.path.insert(0, REPO)
     violations = (check_native_asserts() + check_metric_names()
                   + check_qos_metric_calls()
-                  + check_pipeline_metric_calls())
+                  + check_pipeline_metric_calls()
+                  + check_drivemon_slowlog_metric_calls())
     for v in violations:
         print(v)
     if violations:
