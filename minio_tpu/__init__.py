@@ -24,3 +24,13 @@ Layout:
 """
 
 __version__ = "0.1.0"
+
+# Opt-in runtime lock-order sanitizer: MTPU_LOCKTRACE=1 in the
+# environment traces every lock constructed after this import (a
+# server booted with the flag runs fully sanitized; unset, this is one
+# env read). tests/conftest.py also calls it explicitly so the install
+# lands before jax fills the import cache.
+from .utils.locktrace import maybe_install as _locktrace_maybe_install
+
+_locktrace_maybe_install()
+del _locktrace_maybe_install

@@ -248,6 +248,7 @@ def build_object_layer(disk_args: list[str],
         # Replacement disks detected: heal each affected pool once, in
         # the background (ref monitorLocalDisksAndHeal).
         unique_sets = list(dict.fromkeys(s for s, _ in fresh_all))
+        # mtpu-lint: disable=R1 -- boot-time background heal kickoff; no request context exists yet
         threading.Thread(target=lambda: [s.healer.heal_all()
                                          for s in unique_sets],
                          daemon=True).start()

@@ -226,6 +226,7 @@ class ReplicationPool:
         self._limiters: dict[str, tuple[int, object]] = {}  # arn->(bps, bucket)
         self._stats_mu = threading.Lock()
         self._workers = [
+            # mtpu-lint: disable=R1 -- replication drain daemons outlive the mutating requests that enqueue work
             threading.Thread(target=self._work, daemon=True,
                              name=f"replication-{i}")
             for i in range(workers)]

@@ -141,7 +141,14 @@ class ConfigSys:
         # change before it persists (ref per-subsystem validation in
         # lookupConfigs).
         self.validators: list = []
-        self._write_mu = threading.Lock()
+        # Coarse TRANSACTION lock: a config write's in-memory mutation,
+        # history snapshot, and quorum persist must stay atomic and
+        # ordered end-to-end (two racing writers must never persist out
+        # of mutation order), so the critical section deliberately
+        # spans disk I/O — declared to the runtime sanitizer, which
+        # still watches it for lock-order cycles.
+        from ..utils.locktrace import transaction_lock
+        self._write_mu = transaction_lock(threading.Lock())
         doc = store.load(CONFIG_PATH)
         self._config: dict = doc["config"] if doc else {}
 

@@ -564,6 +564,7 @@ class NewDiskMonitor:
         if self._thread is not None and self._thread.is_alive():
             return
         self._stop.clear()
+        # mtpu-lint: disable=R1 -- boot-time daemon; heal work tags its own bg lane at the call sites
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="newdisk-monitor")
         self._thread.start()
@@ -660,6 +661,7 @@ class MRFQueue:
         if self._thread is not None:
             return
         self._stop.clear()
+        # mtpu-lint: disable=R1 -- boot-time MRF daemon; no request context exists to carry
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 

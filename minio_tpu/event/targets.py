@@ -98,6 +98,7 @@ class QueueStoreTarget(Target):
         os.makedirs(store_dir, exist_ok=True)
         self._stop = threading.Event()
         self._kick = threading.Event()
+        # mtpu-lint: disable=R1 -- queue-store retry daemon: delivery must survive (not inherit) the request deadline
         self._thread = threading.Thread(target=self._retry_loop,
                                         daemon=True)
         self._thread.start()

@@ -747,7 +747,8 @@ class _LocalStageMultipart:
             p = have.get(num)
             if p is None or p["etag"] != etag.strip('"'):
                 raise InvalidPart(f"part {num}")
-            blob += open(os.path.join(base, f"part.{num}"), "rb").read()
+            with open(os.path.join(base, f"part.{num}"), "rb") as pf:
+                blob += pf.read()
             etags.append(p["etag"])
         meta = self.get_upload_meta(bucket, object_name, upload_id)
         info = self.layer.put_object(bucket, object_name, bytes(blob),

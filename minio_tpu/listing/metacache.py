@@ -256,6 +256,7 @@ class MetacacheManager:
                    tracker.cycle if tracker else 0)
         with self._mu:
             self._caches[key] = c
+        # mtpu-lint: disable=R1 -- write-behind persist is deliberately decoupled: the listing answered already
         t = threading.Thread(target=self._persist, args=(c, old_id),
                              daemon=True)
         self.last_persist = t       # joinable by tests/shutdown

@@ -59,6 +59,7 @@ class AuditWebhook:
         self.dropped = 0
         self.sent = 0
         self.failed = 0
+        # mtpu-lint: disable=R1 -- audit drain daemon: entries from many requests share one worker
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name="audit-webhook")
         self._worker.start()

@@ -384,6 +384,7 @@ class EncodeCoalescer:
         with self._lock:
             if self._thread is None or not self._thread.is_alive():
                 self._stopped = False
+                # mtpu-lint: disable=R1 -- coalescer daemon serves MANY requests; lane/deadline are read per item at enqueue
                 self._thread = threading.Thread(
                     target=self._run, daemon=True,
                     name="encode-coalescer")

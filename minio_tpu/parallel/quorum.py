@@ -93,18 +93,11 @@ def _qos_ctx_wrap(fn: Callable) -> Callable:
     without this a shard fan-out would run deadline-UNCAPPED remote
     I/O (and heal's fan-outs would lose their background tag) — the
     same cross-thread gap obs spans close by explicit parent passing.
-    Returns fn unchanged on the default context (no wrap overhead)."""
-    from ..qos import deadline as _dl
-    from ..qos import scheduler as _sched
-    ddl = _dl.current_deadline()
-    lane = _sched.current_lane()
-    if ddl is None and lane == _sched.FOREGROUND:
-        return fn
-
-    def wrapped(*a, **kw):
-        with _dl.deadline_scope(ddl), _sched.lane_scope(lane):
-            return fn(*a, **kw)
-    return wrapped
+    Delegates to the canonical helper (qos/ctx.py, promoted from here
+    once lint rule R1 started requiring it at every thread hop);
+    imported lazily because parallel/ loads before qos/."""
+    from ..qos.ctx import ctx_wrap
+    return ctx_wrap(fn)
 
 
 def submit(fn: Callable[..., Any], *args) -> Any:

@@ -21,4 +21,4 @@ Three cooperating pieces, wired through the whole stack:
   arXiv:1207.6744).
 """
 
-from . import admission, deadline, scheduler  # noqa: F401
+from . import admission, ctx, deadline, scheduler  # noqa: F401

@@ -227,6 +227,7 @@ class DistNSLock:
 
     def _ensure_sweeper(self) -> None:
         if self._sweeper is None or not self._sweeper.is_alive():
+            # mtpu-lint: disable=R1 -- lease-expiry sweeper daemon; runs for the server lifetime
             self._sweeper = threading.Thread(target=self._sweep_loop,
                                              daemon=True)
             self._sweeper.start()

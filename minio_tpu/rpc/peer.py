@@ -252,7 +252,13 @@ class NotificationSys:
             except Exception as exc:  # noqa: BLE001 - per-peer failure
                 results[key] = exc
 
-        threads = [threading.Thread(target=one, args=kv, daemon=True)
+        # ctx_wrap: the RPC client reads the request deadline from a
+        # contextvar (transport.py) — bare threads here ran cluster
+        # fan-outs deadline-UNCAPPED and header-less (found by lint
+        # rule R1, the same gap PR 2 fixed on the quorum pool).
+        from ..qos.ctx import ctx_wrap
+        threads = [threading.Thread(target=ctx_wrap(one), args=kv,
+                                    daemon=True)
                    for kv in self.peers.items()]
         for t in threads:
             t.start()
@@ -262,6 +268,7 @@ class NotificationSys:
 
     def _fanout_async(self, method: str, args: dict) -> None:
         """Push without blocking the mutating request on peer RPCs."""
+        # mtpu-lint: disable=R1 -- fire-and-forget push must OUTLIVE the request; inheriting its deadline would cancel the notify
         threading.Thread(target=self._fanout, args=(method, args),
                          daemon=True).start()
 

@@ -377,6 +377,7 @@ class AdminHandlers:
                 seq["error"] = str(e)
             seq["finished"] = time.time()
 
+        # mtpu-lint: disable=R1 -- heal sequence outlives the admin request that started it (polled via clientToken)
         threading.Thread(target=run, daemon=True,
                          name=f"heal-seq-{token}").start()
         return {"clientToken": token}
@@ -588,6 +589,7 @@ class AdminHandlers:
         peer_entries: list = []
         collector = None
         if p.get("cluster") == "true" and notif is not None:
+            # mtpu-lint: disable=R1 -- trace collection window is its own explicit timeout, not the request budget
             collector = _threading.Thread(
                 target=lambda: peer_entries.extend(
                     notif.trace_all(timeout)), daemon=True)

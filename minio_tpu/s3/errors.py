@@ -185,3 +185,51 @@ ERR_INVALID_STORAGE_CLASS = _e(
     "InvalidStorageClass", "Invalid storage class.", 400)
 ERR_QUOTA_EXCEEDED = _e(
     "QuotaExceeded", "Bucket quota exceeded", 409)
+ERR_STORAGE_FULL = _e(
+    "XMinioStorageFull",
+    "Storage backend has reached its minimum free disk threshold. "
+    "Please delete a few objects to proceed.", 507)
+ERR_OBJECT_CORRUPT = _e(
+    "XMinioObjectCorrupted",
+    "The object failed integrity verification and could not be "
+    "reconstructed from parity.", 500)
+
+
+# Safety-net mapping for per-disk storage errors that escape the engine
+# (ref cmd/object-api-errors.go toObjectErr + cmd/api-errors.go
+# toAPIErrorCode). The engine normally reduces per-disk errors into its
+# own typed errors (ObjectNotFound, BucketNotFound, ...) which handlers
+# map individually; a raw StorageError reaching the top-level handler
+# used to answer an opaque 500 InternalError — this map keeps the
+# 404/409/503 retry semantics instead. Lint rule R5 (tools/mtpu_lint)
+# enforces that every storage/errors.py exception class has an entry,
+# so the safety net stays total as the taxonomy grows. (storage/errors
+# imports nothing, so this import cannot cycle.)
+from ..storage.errors import (DiskFull, DiskNotFound,  # noqa: E402
+                              FaultyDisk, FileCorrupt, FileNotFound,
+                              StorageError, VersionNotFound,
+                              VolumeExists, VolumeNotFound)
+
+STORAGE_ERROR_MAP = {
+    StorageError: ERR_INTERNAL_ERROR,
+    DiskNotFound: ERR_SLOW_DOWN,
+    FaultyDisk: ERR_SLOW_DOWN,
+    VolumeNotFound: ERR_NO_SUCH_BUCKET,
+    VolumeExists: ERR_BUCKET_ALREADY_EXISTS,
+    FileNotFound: ERR_NO_SUCH_KEY,
+    VersionNotFound: ERR_NO_SUCH_VERSION,
+    FileCorrupt: ERR_OBJECT_CORRUPT,
+    DiskFull: ERR_STORAGE_FULL,
+}
+
+
+def storage_api_error(exc: BaseException) -> APIError | None:
+    """The typed S3 APIError for a storage-layer exception, walking the
+    MRO so subclasses inherit their base mapping; None for non-storage
+    errors."""
+    if not isinstance(exc, StorageError):
+        return None
+    for cls in type(exc).__mro__:
+        if cls in STORAGE_ERROR_MAP:
+            return STORAGE_ERROR_MAP[cls]
+    return ERR_INTERNAL_ERROR

@@ -2881,7 +2881,14 @@ class S3Server:
         if cached is not None and \
                 time.monotonic() - cached[0] < self.CLUSTER_METRICS_TTL:
             return cached[1]
-        body = build()
+        # The fill serves EVERY request for the next TTL window, so it
+        # must not inherit the triggering request's remaining deadline:
+        # now that peer fan-out threads carry QoS context (qos/ctx.py),
+        # a nearly-burnt request would otherwise fast-fail the peer
+        # RPCs and poison the cache with a degraded scrape for 10s.
+        from ..qos.deadline import deadline_scope
+        with deadline_scope(None):
+            body = build()
         setattr(self, cache_attr, (time.monotonic(), body))
         return body
 
@@ -3262,11 +3269,18 @@ class S3Server:
                         Logger.get().log_once(
                             f"{self.command} {raw_path}: "
                             f"{type(e).__name__}: {e}", "s3-handler")
-                        err = s3err.ERR_INTERNAL_ERROR
+                        # A raw per-disk storage error that escaped the
+                        # engine's quorum reduction still answers its
+                        # TYPED S3 code (404/409/503/507) instead of an
+                        # opaque 500 — STORAGE_ERROR_MAP is kept total
+                        # by lint rule R5.
+                        err = (s3err.storage_api_error(e)
+                               or s3err.ERR_INTERNAL_ERROR)
                         resp = S3Response(
                             err.http_status,
                             err.xml(raw_path, req.request_id),
-                            {"Content-Type": "application/xml"})
+                            {"Content-Type": "application/xml",
+                             **err.headers()})
                     api = (f"{self.command}-"
                            f"{'object' if req.key else 'bucket' if req.bucket else 'service'}")
                     body_is_stream = not isinstance(
@@ -3500,6 +3514,7 @@ class S3Server:
         self._httpd = _Server((host, port), Handler)
         if cert_manager is not None:
             cert_manager.start()
+        # mtpu-lint: disable=R1 -- the accept loop itself; request context is OPENED per request below it
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()

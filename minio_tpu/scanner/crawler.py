@@ -308,6 +308,7 @@ class DataCrawler:
     def start(self) -> None:
         if self._thread:
             return
+        # mtpu-lint: disable=R1 -- boot-time crawler daemon; tags its own bg lane per sweep step
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="data-crawler")
         self._thread.start()

@@ -75,6 +75,7 @@ class CertManager:
         if self._thread is not None and self._thread.is_alive():
             return
         self._stop.clear()
+        # mtpu-lint: disable=R1 -- cert-reload daemon; no request context exists at boot
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="cert-reloader")
         self._thread.start()

@@ -153,37 +153,33 @@ class Prefetch:
         from ..obs.metrics2 import METRICS2
         METRICS2.set_gauge("minio_tpu_v2_pipeline_depth",
                            {"pipeline": name}, self.depth)
-        # QoS context crosses the thread boundary explicitly (same gap
-        # parallel/quorum._qos_ctx_wrap closes for pool workers).
-        from ..qos import deadline as _dl
-        from ..qos import scheduler as _sched
-        self._deadline = _dl.current_deadline()
-        self._lane = _sched.current_lane()
         self._thread = None
         if not self._inline:
+            # QoS context crosses the thread boundary through the
+            # canonical ctx-wrap helper (qos/ctx.py — captured HERE on
+            # the caller's thread, re-entered around _run on the
+            # worker), the same carrier every R1-checked hop uses.
+            from ..qos.ctx import ctx_wrap
             self._thread = threading.Thread(
-                target=self._run, daemon=True, name=f"pipe-{name}")
+                target=ctx_wrap(self._run), daemon=True,
+                name=f"pipe-{name}")
             self._thread.start()
 
     # -- producer side (worker thread) ---------------------------------
 
     def _run(self) -> None:
-        from ..qos import deadline as _dl
-        from ..qos import scheduler as _sched
         it = iter(self._source)
         end_exc: BaseException | None = None
         try:
-            with _dl.deadline_scope(self._deadline), \
-                    _sched.lane_scope(self._lane):
-                while not self._stop.is_set():
-                    t0 = time.perf_counter()
-                    try:
-                        item = next(it)
-                    except StopIteration:
-                        break
-                    self._produce_s += time.perf_counter() - t0
-                    if not self._put((None, item)):
-                        return  # closed under us; no end marker needed
+            while not self._stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    item = next(it)
+                except StopIteration:
+                    break
+                self._produce_s += time.perf_counter() - t0
+                if not self._put((None, item)):
+                    return  # closed under us; no end marker needed
         except BaseException as e:  # noqa: BLE001 — re-raised consumer-side
             end_exc = e
         finally:

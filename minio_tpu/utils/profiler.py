@@ -31,6 +31,7 @@ class SamplingProfiler:
             raise RuntimeError("profiler already running")
         self._stop.clear()
         self.started_at = time.time()
+        # mtpu-lint: disable=R1 -- sampling daemon observes ALL threads; a request deadline would truncate the profile
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="sampling-profiler")
         self._thread.start()

@@ -21,6 +21,32 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+# Runtime lock-order sanitizer: the whole tier-1 suite runs with traced
+# locks (utils/locktrace.py) so every test doubles as a deadlock-
+# potential probe. Installed HERE, before any minio_tpu module import,
+# so module-level locks are traced too; jax's internals (imported
+# above) stay untraced by construction order. The session-end hook
+# below turns any recorded lock-order cycle into a suite failure.
+os.environ.setdefault("MTPU_LOCKTRACE", "1")
+
+from minio_tpu.utils import locktrace  # noqa: E402
+
+locktrace.maybe_install()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if not locktrace.installed():
+        return
+    cycles = locktrace.cycles()
+    rep = locktrace.report()
+    if rep:
+        print("\n" + rep)
+    if cycles:
+        # A lock-order cycle is a potential deadlock even when this
+        # run's schedule did not trip it — fail the session.
+        session.exitstatus = max(int(exitstatus), 1)
+
+
 # Optional-dep gate: SSE/TLS tests run only where the cryptography
 # package exists (the server itself boots without it and serves plain
 # objects — crypto/sse.py gates the import).

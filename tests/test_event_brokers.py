@@ -65,6 +65,19 @@ def _assert_delivered(got: list[bytes]):
     assert json.loads(got[-1].decode()) == EVENT
 
 
+def _wait_delivered(got: list[bytes], timeout: float = 10.0):
+    """Poll-with-deadline for protocols where send() returning does NOT
+    imply the broker thread finished parsing (MQTT QoS0 publishes carry
+    no ack — every other fake handler appends to `got` before writing
+    the response the client waits on). A fixed assert here was the
+    box-flaky failure mode under full-suite CPU contention."""
+    import time
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline and not got:
+        time.sleep(0.01)
+    _assert_delivered(got)
+
+
 # --- NATS --------------------------------------------------------------------
 
 
@@ -145,7 +158,7 @@ def test_mqtt_target():
     fb = FakeBroker(_mqtt_handler)
     try:
         brokers.MQTTTarget("127.0.0.1", fb.port).send(EVENT)
-        _assert_delivered(fb.got)
+        _wait_delivered(fb.got)
     finally:
         fb.stop()
 

@@ -1,0 +1,13 @@
+"""mtpu-lint: plugin-based AST static analysis for the minio_tpu tree.
+
+Run: ``python -m tools.mtpu_lint minio_tpu/ tools/`` (add ``--json``
+for machine-readable output). Rules live in ``tools/mtpu_lint/rules/``;
+the runtime lock-order sanitizer twin lives in
+``minio_tpu/utils/locktrace.py``. See docs/static-analysis.md.
+"""
+
+from .core import (DEFAULT_BASELINE, Finding, ModuleCtx, ProjectRule,
+                   Rule, RunResult, main, run)
+
+__all__ = ["DEFAULT_BASELINE", "Finding", "ModuleCtx", "ProjectRule",
+           "Rule", "RunResult", "main", "run"]

@@ -1,0 +1,368 @@
+"""mtpu-lint core: module loading, suppression parsing, baseline,
+rule registry plumbing, and the runner.
+
+A rule is an ``ast.NodeVisitor`` subclass of :class:`Rule` (one module
+at a time) or a :class:`ProjectRule` (sees the whole file set — the
+error-map completeness check needs two files at once). Rules report
+through ``self.flag(node, message)``; the runner owns suppression
+filtering, baseline subtraction, and output formatting.
+
+Suppression syntax (checked, not free-form)::
+
+    some_call()  # mtpu-lint: disable=R1 -- justification text
+
+    # mtpu-lint: disable=R3,O2 -- applies to the NEXT line
+    other_call()
+
+A suppression without a justification ("-- text") is itself a finding
+(rule SUP), and so is a suppression that silenced nothing — stale
+waivers rot into lies, so they fail the build like any other finding.
+
+The baseline (``tools/mtpu_lint/baseline.json``) is a checked-in list
+of finding keys to tolerate; this repo ships it EMPTY and intends to
+keep it that way — it exists so a future emergency has an escape hatch
+that is visible in review rather than an ad-hoc skip.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*mtpu-lint:\s*disable=([A-Za-z0-9_,\s]+?)"
+    r"(?:\s*(?:--|—)\s*(\S.*))?\s*$")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str       # repo-relative, forward slashes
+    line: int
+    message: str
+
+    def key(self) -> str:
+        """Baseline identity. The line number is included deliberately:
+        several rules emit constant messages per kind, and a line-less
+        key would let ONE baselined legacy site waive every future
+        violation of that rule in the file. Drift invalidating an entry
+        is the lesser evil — a stale entry surfaces and gets re-judged,
+        a too-broad entry hides new bugs silently."""
+        return f"{self.rule}|{self.path}|{self.line}|{self.message}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+
+@dataclass
+class Suppression:
+    line: int           # line the waiver applies to
+    rules: set[str]
+    reason: str
+    comment_line: int   # where the comment physically sits
+    used: bool = False
+
+
+class ModuleCtx:
+    """One parsed module: tree, source, suppressions, parent links."""
+
+    def __init__(self, path: str, source: str):
+        self.path = os.path.abspath(path)
+        rel = os.path.relpath(self.path, REPO)
+        self.relpath = rel.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.suppressions = _parse_suppressions(source)
+        self._parents: dict[ast.AST, ast.AST] | None = None
+
+    @property
+    def parents(self) -> dict[ast.AST, ast.AST]:
+        if self._parents is None:
+            p: dict[ast.AST, ast.AST] = {}
+            for node in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(node):
+                    p[child] = node
+            self._parents = p
+        return self._parents
+
+
+def _parse_suppressions(source: str) -> list[Suppression]:
+    out: list[Suppression] = []
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            reason = (m.group(2) or "").strip()
+            line = tok.start[0]
+            # A comment alone on its line waives the NEXT line; a
+            # trailing comment waives its own.
+            prefix = source.splitlines()[line - 1][:tok.start[1]]
+            applies = line + 1 if not prefix.strip() else line
+            out.append(Suppression(applies, rules, reason, line))
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+class Rule(ast.NodeVisitor):
+    """Per-module AST rule. Subclasses set `id`/`title`, optionally
+    override `applies`, and implement visit_* methods calling
+    `self.flag`."""
+
+    id = "R0"
+    title = ""
+
+    def applies(self, ctx: ModuleCtx) -> bool:
+        return True
+
+    def check(self, ctx: ModuleCtx) -> list[Finding]:
+        self.ctx = ctx
+        self.findings: list[Finding] = []
+        self.visit(ctx.tree)
+        return self.findings
+
+    def flag(self, node: ast.AST, message: str) -> None:
+        self.findings.append(Finding(
+            self.id, self.ctx.relpath, getattr(node, "lineno", 0),
+            message))
+
+
+class ProjectRule:
+    """Whole-file-set rule (cross-module invariants)."""
+
+    id = "P0"
+    title = ""
+
+    def check_project(self, ctxs: list[ModuleCtx]) -> list[Finding]:
+        raise NotImplementedError
+
+
+# -- shared AST helpers used by several rules --------------------------------
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'a.b.c' for Name/Attribute chains, '' otherwise."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def terminal_name(node: ast.AST) -> str:
+    """The last attribute/name segment ('c' for a.b.c), '' otherwise."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+# -- runner ------------------------------------------------------------------
+
+
+def collect_files(paths: list[str],
+                  missing: list[str] | None = None) -> list[str]:
+    files: list[str] = []
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(REPO, p)
+        found = 0
+        if os.path.isfile(ap):
+            files.append(ap)
+            continue
+        for dirpath, dirs, names in os.walk(ap):
+            dirs[:] = [d for d in dirs if d != "__pycache__"]
+            for f in sorted(names):
+                if f.endswith(".py"):
+                    files.append(os.path.join(dirpath, f))
+                    found += 1
+        if found == 0 and missing is not None:
+            # A typoed/renamed path must FAIL the gate, not lint zero
+            # files and report ok — a vacuous green gate checks nothing.
+            missing.append(p)
+    # De-dup, keep deterministic order.
+    seen: set[str] = set()
+    out = []
+    for f in files:
+        af = os.path.abspath(f)
+        if af not in seen:
+            seen.add(af)
+            out.append(af)
+    return out
+
+
+@dataclass
+class RunResult:
+    findings: list[Finding] = field(default_factory=list)
+    errors: list[str] = field(default_factory=list)
+    files: int = 0
+    baselined: int = 0
+
+
+def load_baseline(path: str | None) -> set[str]:
+    if not path or not os.path.exists(path):
+        return set()
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return {str(k) for k in data}
+
+
+def run(paths: list[str], rules=None,
+        baseline_path: str | None = DEFAULT_BASELINE) -> RunResult:
+    from .rules import all_rules
+    if rules is None:
+        rules = all_rules()
+    res = RunResult()
+    ctxs: list[ModuleCtx] = []
+    missing: list[str] = []
+    for path in collect_files(paths, missing):
+        try:
+            with open(path, encoding="utf-8") as f:
+                ctxs.append(ModuleCtx(path, f.read()))
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            res.errors.append(f"{path}: {type(e).__name__}: {e}")
+    for p in missing:
+        res.errors.append(f"{p}: no Python files found (typoed or "
+                          "renamed path?)")
+    res.files = len(ctxs)
+
+    raw: list[Finding] = []
+    for rule in rules:
+        if isinstance(rule, ProjectRule):
+            raw.extend(rule.check_project(ctxs))
+            continue
+        for ctx in ctxs:
+            if rule.applies(ctx):
+                raw.extend(rule.check(ctx))
+
+    # Suppressions: a finding at line L is waived when a matching
+    # suppression applies to L.
+    by_path = {c.relpath: c for c in ctxs}
+    kept: list[Finding] = []
+    for f in raw:
+        ctx = by_path.get(f.path)
+        waived = False
+        if ctx is not None:
+            for sup in ctx.suppressions:
+                if sup.line == f.line and f.rule in sup.rules:
+                    sup.used = True
+                    waived = True
+        if not waived:
+            kept.append(f)
+
+    # Suppression hygiene: every waiver needs a justification and must
+    # actually silence something. Only waivers for rules that RAN are
+    # judged — a subset run (--rules, the obs_lint shim) must not call
+    # the other rules' waivers stale.
+    ran_ids = {r.id for r in rules}
+    for ctx in ctxs:
+        for sup in ctx.suppressions:
+            if not (sup.rules & ran_ids):
+                continue
+            if not sup.reason:
+                kept.append(Finding(
+                    "SUP", ctx.relpath, sup.comment_line,
+                    "suppression missing justification (write "
+                    "'# mtpu-lint: disable=<rule> -- why')"))
+            elif not sup.used and sup.rules <= ran_ids:
+                # Staleness is only judged when EVERY listed rule ran:
+                # a 'disable=R1,O2' waiver used by R1 must not be
+                # called stale by an O2-only subset run.
+                kept.append(Finding(
+                    "SUP", ctx.relpath, sup.comment_line,
+                    f"unused suppression for {','.join(sorted(sup.rules))}"
+                    " — the rule no longer fires here; remove the waiver"))
+
+    baseline = load_baseline(baseline_path)
+    final = []
+    for f in kept:
+        if f.key() in baseline:
+            res.baselined += 1
+        else:
+            final.append(f)
+    final.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    res.findings = final
+    return res
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.mtpu_lint",
+        description="AST-based concurrency/kernel/error-map linter for "
+                    "the minio_tpu tree")
+    ap.add_argument("paths", nargs="*", default=["minio_tpu", "tools"],
+                    help="files or directories (default: minio_tpu tools)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file of tolerated finding keys")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    from .rules import all_rules
+    rules = all_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.id}: {r.title}")
+        return 0
+    if args.rules:
+        want = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = want - {r.id for r in rules}
+        if unknown:
+            # Same failure class as a typoed path: a misspelled rule id
+            # must not silently select nothing and gate green.
+            print("error: unknown rule id(s): "
+                  + ", ".join(sorted(unknown))
+                  + " (see --list-rules)")
+            return 1
+        rules = [r for r in rules if r.id in want]
+
+    res = run(args.paths or ["minio_tpu", "tools"], rules=rules,
+              baseline_path=args.baseline)
+    if args.json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in res.findings],
+            "errors": res.errors,
+            "files": res.files,
+            "baselined": res.baselined,
+        }, indent=2))
+    else:
+        for f in res.findings:
+            print(f.render())
+        for e in res.errors:
+            print(f"error: {e}")
+        if not res.findings and not res.errors:
+            status = "ok"
+        else:
+            status = f"{len(res.findings)} finding(s)"
+            if res.errors:
+                status += f", {len(res.errors)} error(s)"
+        print(f"mtpu-lint: {res.files} file(s), {status}"
+              + (f", {res.baselined} baselined" if res.baselined else ""))
+    return 1 if (res.findings or res.errors) else 0

@@ -1,0 +1,27 @@
+"""Rule registry: one instance of every plugin, in report order."""
+
+from __future__ import annotations
+
+from .concurrency import ThreadCtxRule
+from .errormap import ErrorMapRule
+from .kernels import KernelPurityRule
+from .locks import BlockingUnderLockRule
+from .obs import (DrivemonSlowlogMetricCallRule, MetricNameRule,
+                  NativeAssertRule, PipelineMetricCallRule,
+                  QosMetricCallRule)
+from .resources import ResourceLeakRule
+
+
+def all_rules():
+    return [
+        ThreadCtxRule(),
+        ResourceLeakRule(),
+        BlockingUnderLockRule(),
+        KernelPurityRule(),
+        ErrorMapRule(),
+        NativeAssertRule(),
+        MetricNameRule(),
+        QosMetricCallRule(),
+        PipelineMetricCallRule(),
+        DrivemonSlowlogMetricCallRule(),
+    ]

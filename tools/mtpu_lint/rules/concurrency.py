@@ -1,0 +1,62 @@
+"""R1 — thread-boundary QoS context propagation.
+
+Deadlines and dispatch lanes live in contextvars, and contextvars do
+not cross threads. PR 2's quorum workers shipped without the wrap and
+ran shard fan-outs deadline-uncapped; this rule makes that class of
+bug a lint failure: every ``threading.Thread(target=...)`` and every
+executor ``.submit(fn, ...)`` inside ``minio_tpu/`` must route its
+callable through the QoS ctx-wrap helper
+(``minio_tpu.qos.ctx.ctx_wrap`` or a local alias ending in
+``ctx_wrap``).
+
+Long-lived daemons started at boot have no request context to carry —
+those sites waive the rule with an inline suppression whose
+justification says exactly that, which doubles as documentation of
+every thread hop in the data plane.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, terminal_name
+
+
+def _is_ctx_wrapped(node: ast.AST) -> bool:
+    """True when the callable expression routes through a ctx-wrap
+    helper: ``ctx_wrap(fn)`` / ``_qos_ctx_wrap(fn)`` / ``qos.ctx.ctx_wrap(fn)``."""
+    return (isinstance(node, ast.Call)
+            and terminal_name(node.func).endswith("ctx_wrap"))
+
+
+class ThreadCtxRule(Rule):
+    id = "R1"
+    title = ("Thread(target=...)/executor submit must carry QoS context "
+             "via the ctx-wrap helper")
+
+    def applies(self, ctx) -> bool:
+        return ctx.relpath.startswith("minio_tpu/")
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        tname = terminal_name(func)
+        if tname == "Thread":
+            # Thread(group, target, ...): the target is usually the
+            # keyword, but the positional form must not bypass the rule.
+            target = next((kw.value for kw in node.keywords
+                           if kw.arg == "target"), None)
+            if target is None and len(node.args) >= 2:
+                target = node.args[1]
+            if target is not None and not _is_ctx_wrapped(target):
+                self.flag(node, (
+                    "Thread target does not carry QoS context — wrap it "
+                    "with qos.ctx.ctx_wrap so the request deadline and "
+                    "dispatch lane survive the thread hop"))
+        elif isinstance(func, ast.Attribute) and tname == "submit":
+            # Executor submit: first positional argument is the callable.
+            if node.args and not _is_ctx_wrapped(node.args[0]):
+                self.flag(node, (
+                    "executor submit() does not carry QoS context — wrap "
+                    "the callable with qos.ctx.ctx_wrap so the request "
+                    "deadline and dispatch lane survive the thread hop"))
+        self.generic_visit(node)

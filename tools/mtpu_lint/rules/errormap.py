@@ -1,0 +1,125 @@
+"""R5 — error-map completeness: every storage error type answers a
+typed S3 error.
+
+A ``storage/errors.py`` exception that escapes the engine used to fall
+into the handler's generic ``except Exception`` and answer an opaque
+500 InternalError — losing the 404/409/503 semantics the client needs
+to retry correctly. ``s3/errors.py`` now carries
+``STORAGE_ERROR_MAP`` (used by the top-level handler as the safety
+net); this rule keeps that map total: every class deriving from
+``StorageError`` must have an entry, every entry must name a real
+class, and every mapped value must be a defined ``ERR_*`` singleton.
+
+The check is cross-file, so it runs as a project rule against the two
+registries directly — findings anchor at the missing/stale lines.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from ..core import REPO, Finding, ModuleCtx, ProjectRule
+
+STORAGE_ERRORS = "minio_tpu/storage/errors.py"
+S3_ERRORS = "minio_tpu/s3/errors.py"
+
+
+def _load(ctxs: list[ModuleCtx], relpath: str) -> ModuleCtx | None:
+    for c in ctxs:
+        if c.relpath == relpath:
+            return c
+    path = os.path.join(REPO, relpath)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return ModuleCtx(path, f.read())
+
+
+def storage_error_classes(ctx: ModuleCtx) -> dict[str, int]:
+    """{class name: lineno} for every subclass of StorageError
+    (transitively) defined in storage/errors.py, base included."""
+    classes: dict[str, int] = {}
+    known = {"StorageError"}
+    # Iterate to a fixpoint so ordering of class defs never matters.
+    changed = True
+    while changed:
+        changed = False
+        for node in ctx.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            if node.name in classes or node.name == "StorageError":
+                continue
+            bases = {b.id for b in node.bases if isinstance(b, ast.Name)}
+            if bases & known:
+                classes[node.name] = node.lineno
+                known.add(node.name)
+                changed = True
+    for node in ctx.tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == "StorageError":
+            classes["StorageError"] = node.lineno
+    return classes
+
+
+def parsed_map(ctx: ModuleCtx):
+    """(map lineno, {class name: lineno}, [value names], [ERR_ names
+    defined in the module]); map lineno is None when absent."""
+    err_names = set()
+    map_line = None
+    keys: dict[str, int] = {}
+    values: list[tuple[str, int]] = []
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id.startswith("ERR_"):
+                    err_names.add(t.id)
+                if (isinstance(t, ast.Name)
+                        and t.id == "STORAGE_ERROR_MAP"
+                        and isinstance(node.value, ast.Dict)):
+                    map_line = node.lineno
+                    for k, v in zip(node.value.keys, node.value.values):
+                        if isinstance(k, ast.Name):
+                            keys[k.id] = k.lineno
+                        if isinstance(v, ast.Name):
+                            values.append((v.id, v.lineno))
+    return map_line, keys, values, err_names
+
+
+class ErrorMapRule(ProjectRule):
+    id = "R5"
+    title = ("every storage/errors.py exception must map to an S3 "
+             "APIError in s3/errors.py STORAGE_ERROR_MAP")
+
+    def check_project(self, ctxs: list[ModuleCtx]) -> list[Finding]:
+        sctx = _load(ctxs, STORAGE_ERRORS)
+        ectx = _load(ctxs, S3_ERRORS)
+        if sctx is None or ectx is None:
+            return []
+        out: list[Finding] = []
+        classes = storage_error_classes(sctx)
+        map_line, keys, values, err_names = parsed_map(ectx)
+        if map_line is None:
+            out.append(Finding(self.id, S3_ERRORS, 1,
+                               "STORAGE_ERROR_MAP is missing — raw "
+                               "storage errors would answer opaque "
+                               "500s"))
+            return out
+        for cls, line in sorted(classes.items()):
+            if cls not in keys:
+                out.append(Finding(
+                    self.id, STORAGE_ERRORS, line,
+                    f"storage error '{cls}' has no S3 APIError mapping "
+                    "in s3/errors.py STORAGE_ERROR_MAP"))
+        for cls, line in sorted(keys.items()):
+            if cls not in classes:
+                out.append(Finding(
+                    self.id, S3_ERRORS, line,
+                    f"STORAGE_ERROR_MAP key '{cls}' is not a "
+                    "storage/errors.py exception (stale entry)"))
+        for name, line in values:
+            if name not in err_names:
+                out.append(Finding(
+                    self.id, S3_ERRORS, line,
+                    f"STORAGE_ERROR_MAP value '{name}' is not a "
+                    "defined APIError singleton"))
+        return out

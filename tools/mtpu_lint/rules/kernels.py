@@ -1,0 +1,109 @@
+"""R4 — kernel purity: no host side effects or data-dependent shapes
+inside jit / Pallas regions in ``ops/`` and ``native/``.
+
+A ``jax.jit``-traced function runs its Python body ONCE at trace time;
+prints, metrics recordings, span events, or file I/O inside it silently
+execute at the wrong time (or never again), and host callbacks
+(``io_callback`` / ``pure_callback`` / ``jax.debug.*``) stall the TPU
+stream on a host round-trip — the exact cost the batched data plane
+exists to avoid. Data-dependent shapes (``.item()``, ``.tolist()``,
+``nonzero``/``unique`` without ``size=``) force a recompile per shape
+or a device sync.
+
+Kernel accounting in this tree deliberately lives OUTSIDE the jit
+boundary (obs/kernel_stats.py wraps the dispatch, not the trace); this
+rule keeps it there.
+
+Detected regions: functions decorated with ``jit`` (bare, attribute, or
+``partial(jax.jit, ...)``) and kernel functions passed as the first
+argument to ``pl.pallas_call``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, dotted_name, terminal_name
+
+_SIDE_EFFECT_NAMES = {"print", "open", "input", "breakpoint"}
+_CALLBACK_NAMES = {"io_callback", "pure_callback", "host_callback",
+                   "debug_callback"}
+_SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+_SHAPE_DEP = {"nonzero", "unique", "flatnonzero", "argwhere"}
+_HOST_STATE_BASES = {"METRICS2", "TRACER", "KERNEL_STATS", "PIPE_STATS",
+                     "DRIVEMON", "SLOWLOG"}
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    if terminal_name(dec) == "jit":
+        return True
+    if isinstance(dec, ast.Call):
+        # partial(jax.jit, ...) / functools.partial(jax.jit, ...)
+        if terminal_name(dec.func) == "partial" and dec.args:
+            return terminal_name(dec.args[0]) == "jit"
+        return terminal_name(dec.func) == "jit"
+    return False
+
+
+class KernelPurityRule(Rule):
+    id = "R4"
+    title = ("no Python side effects, host callbacks, or data-dependent "
+             "shapes inside jit/Pallas regions")
+
+    def applies(self, ctx) -> bool:
+        return ctx.relpath.startswith(("minio_tpu/ops/",
+                                       "minio_tpu/native/"))
+
+    def check(self, ctx):
+        self.ctx = ctx
+        self.findings = []
+        # Pass 1: names of kernel fns handed to pl.pallas_call.
+        self._pallas_kernels: set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Call)
+                    and terminal_name(node.func) == "pallas_call"
+                    and node.args
+                    and isinstance(node.args[0], ast.Name)):
+                self._pallas_kernels.add(node.args[0].id)
+        self._in_kernel = 0
+        self.visit(ctx.tree)
+        return self.findings
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        is_region = (any(_is_jit_decorator(d) for d in node.decorator_list)
+                     or node.name in self._pallas_kernels)
+        if is_region:
+            self._in_kernel += 1
+        self.generic_visit(node)
+        if is_region:
+            self._in_kernel -= 1
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._in_kernel:
+            tname = terminal_name(node.func)
+            dname = dotted_name(node.func)
+            msg = None
+            if isinstance(node.func, ast.Name) and tname in _SIDE_EFFECT_NAMES:
+                msg = (f"'{tname}' inside a jit/Pallas region runs at "
+                       "trace time, not per call")
+            elif tname in _CALLBACK_NAMES or dname.startswith("jax.debug."):
+                msg = (f"host callback '{dname or tname}' stalls the "
+                       "device stream on a host round-trip")
+            elif tname in _SYNC_ATTRS and isinstance(node.func,
+                                                     ast.Attribute):
+                msg = (f"'.{tname}()' forces a device sync / "
+                       "data-dependent value inside the traced region")
+            elif tname in _SHAPE_DEP and not any(
+                    kw.arg == "size" for kw in node.keywords):
+                msg = (f"'{tname}' without size= produces a "
+                       "data-dependent shape (recompile per input)")
+            elif (isinstance(node.func, ast.Attribute)
+                  and dname.split(".")[0] in _HOST_STATE_BASES):
+                msg = (f"host-state recording '{dname}' inside a "
+                       "jit/Pallas region executes at trace time — "
+                       "record around the dispatch instead")
+            if msg is not None:
+                self.flag(node, msg)
+        self.generic_visit(node)

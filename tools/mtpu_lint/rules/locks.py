@@ -1,0 +1,108 @@
+"""R3 — no blocking calls while holding a mutex in hot-path modules.
+
+PR 4 measured a registry-wide drivemon lock costing ~10% of the 1MiB
+PUT p50 on this box before it was split per-drive; a blocking call
+under a mutex is the same failure amplified — every thread that touches
+the lock inherits the full blocking latency. In the hot-path packages
+(``erasure/``, ``storage/``, ``obs/``, ``qos/``, ``parallel/``) this
+rule flags sleep, socket, fsync, ``open``, future-wait, and quorum
+fan-out calls lexically inside a ``with <mutex>:`` block.
+
+What counts as a mutex: a name/attribute whose terminal segment looks
+like a threading primitive (``_mu``, ``_lock``, ``_cv``, ``mutex``,
+``_LOCK`` ...). Namespace locks (``ns_lock.write_locked(...)``) are
+deliberately excluded: they are coarse object-level critical sections
+whose whole purpose is to guard multi-disk I/O.
+
+``cv.wait()`` on the SAME condition variable the block holds is the
+one blessed blocking call (Condition.wait releases the lock while
+waiting); waiting on anything else under a mutex is flagged.
+
+The runtime twin (utils/locktrace.py) catches the dynamic cases this
+lexical rule cannot — sleeps reached through helper calls and
+cross-module lock-order inversions.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Rule, dotted_name, terminal_name
+
+_LOCKISH = re.compile(r"(^|_)(mu|lock|cv|mutex)$", re.IGNORECASE)
+
+# Call terminals that block by nature.
+_BLOCKING_ATTRS = {"connect", "accept", "sendall", "recv", "recv_into",
+                   "makefile", "fsync", "result", "urlopen",
+                   "create_connection"}
+_BLOCKING_NAMES = {"sleep", "fsync", "open", "urlopen",
+                   "create_connection", "parallel_map", "first_success"}
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    if isinstance(expr, (ast.Name, ast.Attribute)):
+        return bool(_LOCKISH.search(terminal_name(expr)))
+    return False
+
+
+class BlockingUnderLockRule(Rule):
+    id = "R3"
+    title = ("no blocking I/O / sleep / fan-out while holding a mutex "
+             "in hot-path modules")
+
+    HOT_PATHS = ("minio_tpu/erasure/", "minio_tpu/storage/",
+                 "minio_tpu/obs/", "minio_tpu/qos/",
+                 "minio_tpu/parallel/")
+
+    def applies(self, ctx) -> bool:
+        return ctx.relpath.startswith(self.HOT_PATHS)
+
+    def check(self, ctx):
+        self.ctx = ctx
+        self.findings = []
+        self._held: list[str] = []  # dotted names of held mutexes
+        self.visit(ctx.tree)
+        return self.findings
+
+    # A nested function body does not execute under the lexical lock.
+    def visit_FunctionDef(self, node):
+        saved, self._held = self._held, []
+        self.generic_visit(node)
+        self._held = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_With(self, node: ast.With) -> None:
+        held = [dotted_name(item.context_expr) for item in node.items
+                if _is_lockish(item.context_expr)]
+        for item in node.items:
+            self.visit(item.context_expr)
+        self._held.extend(held)
+        for stmt in node.body:
+            self.visit(stmt)
+        if held:
+            del self._held[-len(held):]
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._held:
+            tname = terminal_name(node.func)
+            blocking = (
+                (isinstance(node.func, ast.Attribute)
+                 and tname in _BLOCKING_ATTRS)
+                or (isinstance(node.func, ast.Name)
+                    and tname in _BLOCKING_NAMES)
+                or (isinstance(node.func, ast.Attribute)
+                    and tname == "sleep"))
+            if tname == "wait" and isinstance(node.func, ast.Attribute):
+                # cv.wait() on the held condition releases the lock —
+                # fine; .wait() on anything else blocks while holding.
+                base = dotted_name(node.func.value)
+                blocking = base not in self._held
+            if blocking:
+                self.flag(node, (
+                    f"blocking call '{tname}' while holding mutex "
+                    f"'{self._held[-1]}' — move the blocking work "
+                    "outside the critical section"))
+        self.generic_visit(node)

@@ -1,0 +1,130 @@
+"""O1–O5 — the original observability lint, ported as plugins.
+
+These started life as ``tools/obs_lint.py`` (PRs 1, 2, 3, 4); the
+behaviors are unchanged, only the framework is new. ``tools/obs_lint``
+remains as a thin deprecation shim over these rules.
+
+O1  no bare asserts in ``minio_tpu/native/`` (stripped under -O)
+O2  every ``minio_tpu_v2_*`` string literal names a registered metric
+O3  qos/ recording calls pass literal registered names
+O4  utils/pipeline.py recording calls pass literal registered names
+O5  obs/drivemon.py + obs/slowlog.py recording calls likewise
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule
+
+METRIC_PREFIX = "minio_tpu_v2_"
+_RECORDERS = {"inc", "observe", "set_gauge"}
+
+
+def registered_metric_names() -> set[str]:
+    from minio_tpu.obs.metrics2 import METRICS2
+    return set(METRICS2.registered_names())
+
+
+class NativeAssertRule(Rule):
+    id = "O1"
+    title = "no bare asserts for error handling in minio_tpu/native/"
+
+    def applies(self, ctx) -> bool:
+        return ctx.relpath.startswith("minio_tpu/native/")
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        self.flag(node, (
+            "bare assert used for error handling (stripped under -O); "
+            "use an explicit check with a host-path fallback"))
+        self.generic_visit(node)
+
+
+class MetricNameRule(Rule):
+    id = "O2"
+    title = "every minio_tpu_v2_* literal names a registered metric"
+
+    def applies(self, ctx) -> bool:
+        return (ctx.relpath.startswith("minio_tpu/")
+                and ctx.relpath != "minio_tpu/obs/metrics2.py")
+
+    def check(self, ctx):
+        self._registered = registered_metric_names()
+        return super().check(ctx)
+
+    def visit_Constant(self, node: ast.Constant) -> None:
+        if (isinstance(node.value, str)
+                and node.value.startswith(METRIC_PREFIX)):
+            name = node.value
+            base = name
+            for suffix in ("_bucket", "_sum", "_count"):
+                if base.endswith(suffix):
+                    base = base[: -len(suffix)]
+            if name not in self._registered and base not in self._registered:
+                self.flag(node, (
+                    f"unregistered metrics-v2 name {name!r} — register "
+                    "it in minio_tpu/obs/metrics2.py"))
+
+
+def literal_metric_call_findings(tree: ast.AST, what: str,
+                                 registered: set[str]):
+    """(node, message) pairs for METRICS2 recording calls that pass a
+    dynamic or unregistered name — shared by O3/O4/O5 and the obs_lint
+    compatibility shim."""
+    out = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _RECORDERS
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "METRICS2"):
+            continue
+        if not node.args or not (
+                isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            out.append((node, f"{what} metric call must pass a literal "
+                        "metric name (dynamic names are unlintable)"))
+            continue
+        name = node.args[0].value
+        if name not in registered:
+            out.append((node, f"{what} metric {name!r} is not "
+                        "registered in minio_tpu/obs/metrics2.py"))
+    return out
+
+
+class _LiteralCallRule(Rule):
+    what = ""
+    paths: tuple[str, ...] = ()
+
+    def applies(self, ctx) -> bool:
+        return ctx.relpath in self.paths or ctx.relpath.startswith(
+            tuple(p for p in self.paths if p.endswith("/")))
+
+    def check(self, ctx):
+        self.ctx = ctx
+        self.findings = []
+        for node, msg in literal_metric_call_findings(
+                ctx.tree, self.what, registered_metric_names()):
+            self.flag(node, msg)
+        return self.findings
+
+
+class QosMetricCallRule(_LiteralCallRule):
+    id = "O3"
+    title = "qos/ metric recordings use literal registered names"
+    what = "qos"
+    paths = ("minio_tpu/qos/",)
+
+
+class PipelineMetricCallRule(_LiteralCallRule):
+    id = "O4"
+    title = "pipeline metric recordings use literal registered names"
+    what = "pipeline"
+    paths = ("minio_tpu/utils/pipeline.py",)
+
+
+class DrivemonSlowlogMetricCallRule(_LiteralCallRule):
+    id = "O5"
+    title = "drivemon/slowlog metric recordings use literal registered names"
+    what = "drivemon/slowlog"
+    paths = ("minio_tpu/obs/drivemon.py", "minio_tpu/obs/slowlog.py")

@@ -1,0 +1,120 @@
+"""R2 — resource acquisitions must release on every exit path.
+
+The data plane's correctness leans on paired acquire/release:
+admission slots (a leaked slot permanently shrinks a cap), trace spans
+(a leaked root span never lands in the ring and pins its subtree),
+``Prefetch`` pipelines (an unclosed pipeline strands a worker thread on
+a bounded queue), and file handles. PR 2/3 both shipped release-path
+bugs of exactly this shape.
+
+The rule flags an acquisition unless the exit path is structural:
+
+- used as a ``with`` context manager (directly or via a wrapper), or
+- assigned to a name that is cleaned up in a ``finally`` block, used as
+  a later ``with`` target, or
+- ownership is transferred: the value (or its name) is returned, or
+  stored onto an object attribute (``self.x = open(...)`` — lifecycle
+  owned by the object).
+
+Deliberate deferred-release designs (the streaming-GET admission slot
+released from the request-finish callback) waive the rule inline with
+a justification, which keeps every such path documented at the site.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, dotted_name, terminal_name
+
+RELEASE_ATTRS = {"close", "release", "finish", "shutdown", "stop",
+                 "abandon", "join"}
+
+
+def _acquisition_kind(node: ast.Call) -> str | None:
+    func = node.func
+    tname = terminal_name(func)
+    if isinstance(func, ast.Name) and tname == "open":
+        return "file handle"
+    if tname == "Prefetch":
+        return "Prefetch pipeline"
+    if tname == "begin":
+        base = dotted_name(func)
+        if "TRACER" in base or "tracer" in base:
+            return "root span"
+    if tname == "acquire" and isinstance(func, ast.Attribute):
+        base = dotted_name(func.value).lower()
+        if "admission" in base:
+            return "admission slot"
+    return None
+
+
+class ResourceLeakRule(Rule):
+    id = "R2"
+    title = ("acquisitions (slots, spans, Prefetch, file handles) must "
+             "release in a finally / context manager on every exit path")
+
+    def _enclosing_scope(self, node: ast.AST) -> ast.AST:
+        cur = self.ctx.parents.get(node)
+        while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)):
+            cur = self.ctx.parents.get(cur)
+        return cur if cur is not None else self.ctx.tree
+
+    def _scope_evidence(self, scope: ast.AST):
+        """Names with structural cleanup in `scope`: released in a
+        finally, entered as a with-context, or returned."""
+        cleaned: set[str] = set()
+        for n in ast.walk(scope):
+            if isinstance(n, (ast.Try,)):
+                for stmt in n.finalbody:
+                    for sub in ast.walk(stmt):
+                        if isinstance(sub, ast.Name):
+                            cleaned.add(sub.id)
+            elif isinstance(n, ast.With):
+                for item in n.items:
+                    if isinstance(item.context_expr, ast.Name):
+                        cleaned.add(item.context_expr.id)
+            elif isinstance(n, ast.Return) and isinstance(n.value, ast.Name):
+                cleaned.add(n.value.id)
+        return cleaned
+
+    def visit_Call(self, node: ast.Call) -> None:
+        kind = _acquisition_kind(node)
+        if kind is None:
+            self.generic_visit(node)
+            return
+        # Structural exits visible from the ancestor chain: a with-item,
+        # a return (ownership transfer), a decorator, or an attribute
+        # store (object-owned lifecycle).
+        assigned_name: str | None = None
+        cur, parent = node, self.ctx.parents.get(node)
+        ok = False
+        while parent is not None and not isinstance(
+                parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Module)):
+            if isinstance(parent, ast.withitem):
+                ok = True
+                break
+            if isinstance(parent, (ast.Return, ast.Yield, ast.YieldFrom)):
+                ok = True
+                break
+            if isinstance(parent, ast.Assign):
+                targets = parent.targets
+                if len(targets) == 1 and isinstance(targets[0], ast.Name):
+                    assigned_name = targets[0].id
+                elif any(isinstance(t, (ast.Attribute, ast.Subscript))
+                         for t in targets):
+                    ok = True  # stored onto an object: owned lifecycle
+                break
+            cur, parent = parent, self.ctx.parents.get(parent)
+        if not ok and assigned_name is not None:
+            scope = self._enclosing_scope(node)
+            if assigned_name in self._scope_evidence(scope):
+                ok = True
+        if not ok:
+            self.flag(node, (
+                f"{kind} acquired without a structural release — use a "
+                "with-block or release it in a finally on every exit "
+                "path"))
+        self.generic_visit(node)

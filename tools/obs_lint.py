@@ -1,42 +1,22 @@
-"""Fast observability lint, wired into the tier-1 path
-(tests/test_observability.py runs main() and fails on any violation).
+"""DEPRECATION SHIM — the observability lint moved into the
+plugin-based framework at ``tools/mtpu_lint`` (rules O1–O5).
 
-Five invariants, all cheap AST walks:
+Prefer ``python -m tools.mtpu_lint minio_tpu/ tools/``, which runs
+these five rules plus the concurrency/resource/lock/kernel/error-map
+rules (R1–R5) with suppression and baseline support. This module keeps
+the original entry points so existing tests, docs, and muscle memory
+stay working:
 
-1. No bare ``assert`` used for error handling in ``minio_tpu/native/``:
-   a ``python -O`` run strips asserts, which would let a garbled native
-   kernel return flow onward as valid data (the hh256 row-count check
-   regressed exactly this way once — now an explicit branch).
-
-2. No unregistered metrics-v2 names: every ``minio_tpu_v2_*`` string
-   literal in the package must be registered in
-   ``minio_tpu/obs/metrics2.py`` — the namespace the node AND cluster
-   endpoints render must not drift (the registry also raises at
-   runtime; this catches dead/typoed names before they ever record).
-
-3. Every metric RECORDING call in ``minio_tpu/qos/`` (METRICS2.inc /
-   observe / set_gauge) must pass a literal, registered name: the QoS
-   layer's shed/wait/lane numbers are the acceptance evidence for
-   brownout behavior, so a dynamically-built (unlintable) or typoed
-   name there is a lint failure, not a runtime surprise.
-
-4. The same literal-registered-name bar for the data-plane pipeline's
-   recordings (``minio_tpu/utils/pipeline.py``): the depth/stall
-   series are how operators and bench.py detect lost overlap.
-
-5. The same bar again for the drive-health monitor and the
-   slow-request log (``minio_tpu/obs/drivemon.py``,
-   ``minio_tpu/obs/slowlog.py``): their state/blame series are the
-   operator-facing evidence for "which disk is slow" and "why was
-   this request slow" — a typoed or dynamically-built name there
-   silently blinds both questions.
+- ``main()`` runs exactly the five ported rules over ``minio_tpu/``;
+- ``check_*()`` return the same violation-string lists as before;
+- ``_check_literal_metric_calls(paths, what)`` checks arbitrary files
+  (the unit tests feed it synthetic modules).
 
 Run standalone: ``python -m tools.obs_lint``.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
@@ -45,140 +25,84 @@ PKG = os.path.join(REPO, "minio_tpu")
 METRIC_PREFIX = "minio_tpu_v2_"
 
 
-def _py_files(root: str):
-    for dirpath, _dirs, files in os.walk(root):
-        for f in files:
-            if f.endswith(".py"):
-                yield os.path.join(dirpath, f)
+def _obs_rules():
+    from tools.mtpu_lint.rules.obs import (DrivemonSlowlogMetricCallRule,
+                                           MetricNameRule,
+                                           NativeAssertRule,
+                                           PipelineMetricCallRule,
+                                           QosMetricCallRule)
+    return [NativeAssertRule(), MetricNameRule(), QosMetricCallRule(),
+            PipelineMetricCallRule(), DrivemonSlowlogMetricCallRule()]
 
+
+def _run_rules(rules, paths=("minio_tpu",)) -> list[str]:
+    from tools.mtpu_lint.core import run
+    res = run(list(paths), rules=rules)
+    out = [f.render() for f in res.findings]
+    out.extend(res.errors)
+    return out
+
+
+# Each check parses only the files its rule can apply to (the old
+# obs_lint behavior); main() runs all five over one shared parse.
 
 def check_native_asserts() -> list[str]:
-    """Bare asserts in minio_tpu/native/ are error handling by
-    construction (the package has no test helpers) — flag them all."""
-    violations = []
-    native = os.path.join(PKG, "native")
-    for path in _py_files(native):
-        with open(path, encoding="utf-8") as f:
-            tree = ast.parse(f.read(), filename=path)
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Assert):
-                rel = os.path.relpath(path, REPO)
-                violations.append(
-                    f"{rel}:{node.lineno}: bare assert used for error "
-                    "handling (stripped under -O); use an explicit "
-                    "check with a host-path fallback")
-    return violations
+    from tools.mtpu_lint.rules.obs import NativeAssertRule
+    return _run_rules([NativeAssertRule()], ["minio_tpu/native"])
 
 
 def check_metric_names() -> list[str]:
-    """Every minio_tpu_v2_* string literal in the package must name a
-    registered metric (its base name, for _bucket/_sum/_count/label
-    suffixes rendered by the registry itself)."""
-    from minio_tpu.obs.metrics2 import METRICS2
-    registered = METRICS2.registered_names()
-    registry_file = os.path.join(PKG, "obs", "metrics2.py")
-    violations = []
-    for path in _py_files(PKG):
-        if os.path.abspath(path) == os.path.abspath(registry_file):
-            continue
-        with open(path, encoding="utf-8") as f:
-            tree = ast.parse(f.read(), filename=path)
-        for node in ast.walk(tree):
-            if not (isinstance(node, ast.Constant)
-                    and isinstance(node.value, str)
-                    and node.value.startswith(METRIC_PREFIX)):
-                continue
-            name = node.value
-            if name in registered:
-                continue
-            # Allow rendered-suffix forms if some caller builds them.
-            base = name
-            for suffix in ("_bucket", "_sum", "_count"):
-                if base.endswith(suffix):
-                    base = base[: -len(suffix)]
-            if base in registered:
-                continue
-            rel = os.path.relpath(path, REPO)
-            violations.append(
-                f"{rel}:{node.lineno}: unregistered metrics-v2 name "
-                f"{name!r} — register it in minio_tpu/obs/metrics2.py")
-    return violations
-
-
-def _check_literal_metric_calls(paths, what: str) -> list[str]:
-    """Every METRICS2 recording call (inc/observe/set_gauge) in `paths`
-    must pass a literal, registered metric name (rule 2 only sees
-    string literals — a name built at runtime would slip past it; here
-    the CALL itself is the unit checked)."""
-    from minio_tpu.obs.metrics2 import METRICS2
-    registered = METRICS2.registered_names()
-    recorders = {"inc", "observe", "set_gauge"}
-    violations = []
-    for path in paths:
-        with open(path, encoding="utf-8") as f:
-            tree = ast.parse(f.read(), filename=path)
-        for node in ast.walk(tree):
-            if not (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)
-                    and node.func.attr in recorders
-                    and isinstance(node.func.value, ast.Name)
-                    and node.func.value.id == "METRICS2"):
-                continue
-            rel = os.path.relpath(path, REPO)
-            if not node.args or not (
-                    isinstance(node.args[0], ast.Constant)
-                    and isinstance(node.args[0].value, str)):
-                violations.append(
-                    f"{rel}:{node.lineno}: {what} metric call must pass "
-                    "a literal metric name (dynamic names are "
-                    "unlintable)")
-                continue
-            name = node.args[0].value
-            if name not in registered:
-                violations.append(
-                    f"{rel}:{node.lineno}: {what} metric {name!r} is "
-                    "not registered in minio_tpu/obs/metrics2.py")
-    return violations
+    from tools.mtpu_lint.rules.obs import MetricNameRule
+    return _run_rules([MetricNameRule()])
 
 
 def check_qos_metric_calls() -> list[str]:
-    """Rule 3: the QoS layer's shed/wait/lane numbers are the
-    acceptance evidence for brownout behavior — typoed or dynamic
-    names there are a lint failure, not a runtime surprise."""
-    return _check_literal_metric_calls(
-        _py_files(os.path.join(PKG, "qos")), "qos")
+    from tools.mtpu_lint.rules.obs import QosMetricCallRule
+    return _run_rules([QosMetricCallRule()], ["minio_tpu/qos"])
 
 
 def check_pipeline_metric_calls() -> list[str]:
-    """Rule 4: the data-plane pipeline's depth/stall series
-    (utils/pipeline.py) are how operators and bench.py detect lost
-    overlap — same literal-registered-name bar as the qos layer."""
-    return _check_literal_metric_calls(
-        [os.path.join(PKG, "utils", "pipeline.py")], "pipeline")
+    from tools.mtpu_lint.rules.obs import PipelineMetricCallRule
+    return _run_rules([PipelineMetricCallRule()],
+                      ["minio_tpu/utils/pipeline.py"])
 
 
 def check_drivemon_slowlog_metric_calls() -> list[str]:
-    """Rule 5: drivemon/slowlog recordings are the operator-facing
-    evidence for drive health and slow-request blame — every recording
-    call there must pass a literal, registered metric name."""
-    return _check_literal_metric_calls(
-        [os.path.join(PKG, "obs", "drivemon.py"),
-         os.path.join(PKG, "obs", "slowlog.py")], "drivemon/slowlog")
+    from tools.mtpu_lint.rules.obs import DrivemonSlowlogMetricCallRule
+    return _run_rules([DrivemonSlowlogMetricCallRule()],
+                      ["minio_tpu/obs/drivemon.py",
+                       "minio_tpu/obs/slowlog.py"])
+
+
+def _check_literal_metric_calls(paths, what: str) -> list[str]:
+    """Compatibility entry point: lint arbitrary files (tests feed
+    synthetic modules through this)."""
+    import ast
+
+    from tools.mtpu_lint.rules.obs import (literal_metric_call_findings,
+                                           registered_metric_names)
+    registered = registered_metric_names()
+    violations = []
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=str(path))
+        for node, msg in literal_metric_call_findings(tree, what,
+                                                      registered):
+            rel = os.path.relpath(str(path), REPO)
+            violations.append(f"{rel}:{node.lineno}: {msg}")
+    return violations
 
 
 def main() -> int:
     if REPO not in sys.path:
         sys.path.insert(0, REPO)
-    violations = (check_native_asserts() + check_metric_names()
-                  + check_qos_metric_calls()
-                  + check_pipeline_metric_calls()
-                  + check_drivemon_slowlog_metric_calls())
+    violations = _run_rules(_obs_rules())
     for v in violations:
         print(v)
     if violations:
         print(f"obs_lint: {len(violations)} violation(s)")
         return 1
-    print("obs_lint: ok")
+    print("obs_lint: ok (deprecated shim — use python -m tools.mtpu_lint)")
     return 0
 
 
