@@ -462,7 +462,120 @@ def bench_heal(np, workdir: str, device: bool = False) -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
-# --- config 6: QoS brownout — overload shedding + heal interference ----------
+# --- config 6: degraded tail — hedged reads vs one slow drive ----------------
+
+
+def bench_degraded_tail(np, workdir: str) -> dict:
+    """Paired hedging-on/off GET p99 with ONE injected-slow drive (a
+    data-shard holder at 10x-ish the healthy read), using PR 4's
+    paired-delta method: each hedging-ON GET is paired with the
+    immediately-following hedging-OFF GET (alternating pair order so
+    position-within-pair effects don't alias), so VM drift moves both
+    halves together and the hedge's tail win survives. Also reports
+    the hedge fire rate and the wasted-read fraction (completed
+    hedges the primary beat anyway)."""
+    from minio_tpu.erasure.engine import ErasureObjects
+    from minio_tpu.faultinject import FAULTS
+    from minio_tpu.obs.metrics2 import METRICS2
+    from minio_tpu.s3.client import S3Client
+    from minio_tpu.s3.server import S3Server
+    from minio_tpu.storage.xl import XLStorage
+
+    def hedges(result: str) -> int:
+        return METRICS2.get("minio_tpu_v2_hedged_reads_total",
+                            {"result": result}) or 0
+
+    access, secret = "benchadmin", "benchadmin-secret"
+    root = os.path.join(workdir, "cfg-degraded")
+    disks = [XLStorage(os.path.join(root, f"disk{i}"))
+             for i in range(6)]
+    layer = ErasureObjects(disks, 4, 2, block_size=256 * 1024)
+    srv = S3Server(layer, access, secret)
+    port = srv.start()
+    try:
+        client = S3Client("127.0.0.1", port, access, secret)
+        client.make_bucket("bench")
+        rng = np.random.default_rng(7)
+        body = rng.integers(0, 256, 1024 * 1024).astype(
+            np.uint8).tobytes()
+        r = client.put_object("bench", "obj", body)
+        if r.status != 200:
+            raise RuntimeError(f"PutObject failed: {r.status}")
+        # Calibrate the hedge budget on healthy reads.
+        for _ in range(10):
+            if client.get_object("bench", "obj").status != 200:
+                raise RuntimeError("warm GET failed")
+        healthy_ms = []
+        for _ in range(10):
+            t0 = time.perf_counter()
+            client.get_object("bench", "obj")
+            healthy_ms.append((time.perf_counter() - t0) * 1e3)
+        # Slow ONE data-shard holder's shard reads to ~10x the
+        # healthy GET (shard reads are a fraction of that, so the
+        # multiple vs the read itself is far larger).
+        import json as _json
+        slow = None
+        for d in disks:
+            meta = os.path.join(d.root, "bench", "obj", "xl.meta")
+            doc = _json.loads(open(meta).read())
+            if doc["versions"][0]["erasure"]["index"] == 1:
+                slow = d.root
+                break
+        inj_ms = max(50.0, 10.0 * statistics.median(healthy_ms))
+        FAULTS.load_plan({"seed": 1, "rules": [
+            {"kind": "latency", "target": slow, "op": "read_file",
+             "latency_ms": inj_ms}]})
+        fired0, won0, wasted0 = (hedges("fired"), hedges("won"),
+                                 hedges("wasted"))
+        lat_on: list = []
+        lat_off: list = []
+        try:
+            for i in range(40):
+                order = (True, False) if i % 2 == 0 else (False, True)
+                for on in order:
+                    layer.hedge_enabled = on
+                    t0 = time.perf_counter()
+                    g = client.get_object("bench", "obj")
+                    (lat_on if on else lat_off).append(
+                        (time.perf_counter() - t0) * 1e3)
+                    if g.status != 200:
+                        raise RuntimeError(f"GET failed: {g.status}")
+        finally:
+            layer.hedge_enabled = True
+            FAULTS.clear()
+
+        def p99(xs):
+            return sorted(xs)[max(0, int(len(xs) * 0.99) - 1)]
+
+        fired = hedges("fired") - fired0
+        completed = (hedges("won") - won0) + (hedges("wasted")
+                                              - wasted0)
+        return {
+            "metric": "degraded_get_p99_hedged_ms",
+            "value": round(p99(lat_on), 3), "unit": "ms",
+            "object_bytes": len(body),
+            "injected_latency_ms": round(inj_ms, 1),
+            "healthy_get_p50_ms": round(
+                statistics.median(healthy_ms), 3),
+            "get_p99_hedge_off_ms": round(p99(lat_off), 3),
+            "get_p50_hedge_on_ms": round(
+                statistics.median(lat_on), 3),
+            "get_p50_hedge_off_ms": round(
+                statistics.median(lat_off), 3),
+            # How often the budget tripped, and how much of the fired
+            # I/O the primary beat anyway (the hedging tax).
+            "hedge_fire_rate": round(fired / max(1, len(lat_on)), 3),
+            "hedge_wasted_fraction": round(
+                (hedges("wasted") - wasted0) / max(1, completed), 3),
+            "hedge_budget_ms": round(
+                layer.hedge_budget.budget() * 1e3, 3),
+        }
+    finally:
+        srv.stop()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+# --- config 7: QoS brownout — overload shedding + heal interference ----------
 
 
 def bench_qos_brownout(np, workdir: str) -> dict:
@@ -724,6 +837,8 @@ def main() -> None:
                      ("get_2lost",
                       lambda: bench_get_with_loss(np, workdir, False)),
                      ("heal", lambda: bench_heal(np, workdir, False)),
+                     ("degraded_tail",
+                      lambda: bench_degraded_tail(np, workdir)),
                      ("qos_brownout",
                       lambda: bench_qos_brownout(np, workdir))):
         _progress(f"config {name} (host mode)")

@@ -420,6 +420,15 @@ def _serve(args) -> int:
                     "MINIO_HEAL_NEWDISK_INTERVAL", "10"))
                 mon.start()
                 monitors.append(mon)
+            # Probation probes close the quarantine loop: a drive the
+            # health monitor pulled from the data plane earns its way
+            # back through bitrot-verified shadow reads.
+            prober = getattr(es, "quarantine_prober", None)
+            if prober is not None:
+                prober.interval = float(os.environ.get(
+                    "MINIO_HEAL_PROBATION_INTERVAL", "5"))
+                prober.start()
+                monitors.append(prober)
 
     _wait_for_sigterm()
     for mon in monitors:

@@ -70,6 +70,21 @@ DEFAULT_KVS: dict[str, dict[str, str]] = {
         "endpoint": "",
         "auth_token": "",
     },
+    # Internal RPC transport knobs (rpc/transport.py): offline_retry
+    # is how long a peer stays health-gated after a failure before a
+    # reconnect probe (jittered +0-50% per mark so a restarted peer
+    # is not thundering-herded by the whole cluster at once).
+    "rpc": {
+        "offline_retry": "2s",
+    },
+    # Runtime fault injection (minio_tpu/faultinject): enable=on with
+    # a plan (COMPACT JSON — no spaces — or set it via the admin
+    # /fault-inject API) loads the deterministic fault plan at apply
+    # time; enable=off clears any config-loaded plan.
+    "fault_inject": {
+        "enable": "off",
+        "plan": "",
+    },
     # Slow-request capture SLOs (obs/slowlog.py): any request past its
     # class threshold (ms) lands in the slowlog ring with per-layer
     # blame. Per-class keys override the default; empty = inherit;

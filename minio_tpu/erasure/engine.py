@@ -151,15 +151,28 @@ class ErasureObjects:
         # latency EWMAs relative to the set median (obs/drivemon.py) —
         # a laggard drive is only an outlier against its own quorum
         # peers, never against unrelated pools.
-        from ..obs.drivemon import DRIVEMON
+        from ..obs.drivemon import DRIVEMON, drive_key
 
-        def _ep(d) -> str:
-            try:
-                return d.endpoint()
-            except Exception:  # duck-typed test doubles
-                return str(d)
-
-        DRIVEMON.register_set([_ep(d) for d in self.disks])
+        # Per-disk health identity, index-aligned with self.disks: the
+        # read-selection, hedging, and quarantine paths all key the
+        # monitor by it.
+        self.endpoints = [drive_key(d) for d in self.disks]
+        DRIVEMON.register_set(self.endpoints)
+        # Hedged shard reads (the reaction half of drive health): when
+        # a shard read straggles past the adaptive budget — multiplier
+        # x rolling p75 of healthy shard reads (utils/dyntimeout.py
+        # PercentileBudget) — a backup read of a spare shard fires on
+        # the background QoS lane; first response wins, the loser is
+        # discarded. This bounds GET tail latency by the budget, not
+        # the straggler (arXiv:1709.05365's regime; any-k-of-n reads
+        # per arXiv:1504.07038).
+        from ..utils.dyntimeout import PercentileBudget
+        self.hedge_enabled = True
+        # Floor sits above OS-scheduler jitter (tens of ms under
+        # contention): a stall the scheduler alone can cause must not
+        # fire backup I/O, or a busy box hedges every read.
+        self.hedge_budget = PercentileBudget(
+            multiplier=4.0, floor=0.050, ceiling=2.0)
         # Streaming-pipeline knobs: how many bytes one encode dispatch /
         # one read window group covers, and how many batches/groups may
         # be in flight at once (utils/pipeline.py). Peak data-plane
@@ -178,11 +191,16 @@ class ErasureObjects:
         from ..parallel.nslock import LocalNSLock
         from .heal import Healer, MRFQueue, NewDiskMonitor
         from .multipart import MultipartUploads
+        from .heal import QuarantineProber
         self.healer = Healer(self)
         self.mrf = MRFQueue(self.healer)
         # Not started by default; the server boot starts it (tests and
         # library users drive tick() directly).
         self.new_disk_monitor = NewDiskMonitor(self.healer)
+        # Probation probes for quarantined drives (same start contract
+        # as the new-disk monitor: server boot starts it, tests drive
+        # tick() directly).
+        self.quarantine_prober = QuarantineProber(self)
         self.multipart = MultipartUploads(self)
         # Namespace locks: in-process by default; distributed deployments
         # inject a dsync-backed provider (ref ObjectLayer.NewNSLock).
@@ -193,6 +211,19 @@ class ErasureObjects:
         from ..scanner.tracker import DataUpdateTracker
         self.update_tracker = DataUpdateTracker()
         self.metacache = MetacacheManager(self)
+
+    def shutdown(self) -> None:
+        """Stop this engine's background daemons — the MRF heal queue
+        worker, the new-disk monitor, and the quarantine prober. A
+        stopped deployment's daemons must not keep healing into the
+        void: a test or embedder that drops the engine otherwise leaks
+        threads that churn dead disks (and steal CPU from whatever
+        runs next in the process). Server shutdown calls this; safe to
+        call twice."""
+        self.healer.shutdown()
+        self.mrf.stop()
+        self.new_disk_monitor.stop()
+        self.quarantine_prober.stop()
 
     def _mark_update(self, bucket: str, object_name: str = "") -> None:
         self.update_tracker.mark(bucket, object_name)
@@ -449,6 +480,11 @@ class ErasureObjects:
         # degradation + reduceWriteQuorumErrs, cmd/erasure-encode.go:56-70).
         alive = [True] * n
         disk_errs: list = [None] * n
+        # Quarantined drives are skipped up front (degraded write):
+        # their shards ride the same dead-disk path below — tmp
+        # cleanup + MRF heal requeue — so the object converges back to
+        # full redundancy once the drive is reinstated.
+        self._quarantine_skip(alive, disk_errs, wq)
 
         def append_one(i: int, payload: bytes, parent=None):
             if parent is None:  # untraced fast path
@@ -795,10 +831,49 @@ class ErasureObjects:
     def _read_file_infos(self, bucket: str, object_name: str,
                          version_id: str = "",
                          ) -> tuple[list[FileInfo | None], list]:
+        # Quarantined drives serve NO data-plane reads — the metadata
+        # fan-out included (parallel_map joins every thunk, so one
+        # quarantined-and-stalling drive would drag every stat/GET).
+        # They answer as pre-failed; the quorum math treats that like
+        # any other down disk.
+        from ..obs.drivemon import DRIVEMON
+
+        def one(i: int):
+            if DRIVEMON.is_quarantined(self.endpoints[i]):
+                raise serr.DriveQuarantined(self.endpoints[i])
+            return self.disks[i].read_version(bucket, object_name,
+                                              version_id)
+
         results, errs = parallel_map(
-            [lambda d=d: d.read_version(bucket, object_name, version_id)
-             for d in self.disks])
+            [lambda i=i: one(i) for i in range(len(self.disks))])
         fis = [r if e is None else None for r, e in zip(results, errs)]
+        # Availability over hygiene: when the healthy drives alone
+        # can't produce k readable shards (quarantine plus a real
+        # failure), the quarantined drives ARE the remaining copies —
+        # probe them after all, serially (they may stall; never let
+        # them drag the healthy fan-out's join). Without this second
+        # pass the shard map never includes a quarantined drive and
+        # _read_order's last-resort re-entry has nothing to extend
+        # with — m+1 quarantined drives would fail every GET in the
+        # set despite byte-exact data. A healthy disk answering a
+        # namespace miss is DEFINITIVE (the object simply isn't
+        # there) — without that guard every 404-path request would
+        # block on a possibly-hung quarantined drive, the exact stall
+        # the pre-fail above exists to avoid (same policy as
+        # iam.ConfigStore).
+        definitive = (serr.FileNotFound, serr.VersionNotFound,
+                      serr.VolumeNotFound)
+        if (sum(f is not None for f in fis) < self.k
+                and not any(isinstance(e, definitive) for e in errs)):
+            for i, e in enumerate(errs):
+                if not isinstance(e, serr.DriveQuarantined):
+                    continue
+                try:
+                    fis[i] = self.disks[i].read_version(
+                        bucket, object_name, version_id)
+                    errs[i] = None
+                except Exception as e2:  # keep the quorum math exact
+                    errs[i] = e2
         return fis, errs
 
     def _quorum_file_info(self, bucket: str, object_name: str,
@@ -917,6 +992,122 @@ class ErasureObjects:
             ctx.__exit__(None, None, None)
             raise
 
+    def _quarantine_skip(self, alive: list, disk_errs: list,
+                         wq: int) -> list[int]:
+        """Degraded write: pre-mark quarantined drives dead for a write
+        fan-out, so their shards fall to the MRF heal queue exactly
+        like a failed write would — but only while enough healthy
+        drives remain for write quorum. With quorum at stake,
+        availability wins and the quarantined drives are attempted
+        anyway. Returns the skipped disk indices."""
+        from ..obs.drivemon import DRIVEMON
+        q = [i for i in range(len(self.disks))
+             if alive[i] and DRIVEMON.is_quarantined(self.endpoints[i])]
+        if not q or sum(alive) - len(q) < wq:
+            return []
+        for i in q:
+            alive[i] = False
+            disk_errs[i] = serr.DriveQuarantined(
+                f"{self.endpoints[i]}: write skipped (quarantined)")
+        return q
+
+    def _read_order(self, by_shard: list[int | None], k: int,
+                    m: int) -> list[int]:
+        """Health-ranked shard read order: pick the k healthiest of
+        k+m. Sort key is (health state, parity flag, read EWMA) — an
+        OK data shard beats an OK parity shard (reading parity forces
+        a reconstruct), and ANY healthy shard beats a suspect one (a
+        reconstruct is cheaper than waiting on a dragging drive; the
+        Mojette any-k-of-n argument, arXiv:1504.07038). Quarantined
+        drives serve no data-plane reads at all — they re-enter only
+        if exclusion would leave fewer than k readable shards
+        (availability over hygiene)."""
+        from ..obs.drivemon import DRIVEMON, OK, SUSPECT
+        ranked: list[tuple] = []
+        quarantined: list[int] = []
+        for j, pos in enumerate(by_shard):
+            if pos is None:
+                continue
+            ep = self.endpoints[pos]
+            if DRIVEMON.is_quarantined(ep):
+                quarantined.append(j)
+                continue
+            state = DRIVEMON.state_of(ep)
+            srank = 0 if state == OK else (1 if state == SUSPECT else 2)
+            ewma = DRIVEMON.ewma_for(ep).get("read", 0.0)
+            ranked.append((srank, 0 if j < k else 1, ewma, j))
+        ranked.sort()
+        order = [t[3] for t in ranked]
+        if len(order) < k:
+            order.extend(quarantined)
+        return order
+
+    def _hedged_fetch(self, primary: list[int], spares: list[int],
+                      fetch, win_off: int, n_cov: int, windows: dict,
+                      k: int, parent_span) -> None:
+        """Fan the k primary shard reads out, hedging stragglers: when
+        the group hasn't assembled k windows within the adaptive
+        budget (hedge_budget), backup reads of spare shards fire on
+        the BACKGROUND QoS lane — they defer to foreground kernel
+        work, so hedges can never amplify an overload. First response
+        wins; straggler futures are cancelled if unstarted, otherwise
+        their late results are simply discarded (the group only ever
+        consumes k verified windows)."""
+        from concurrent.futures import FIRST_COMPLETED
+        from concurrent.futures import wait as _fwait
+        from ..obs.metrics2 import METRICS2
+        from ..qos.scheduler import BACKGROUND, lane_scope
+        budget_s = self.hedge_budget.budget()
+        METRICS2.set_gauge("minio_tpu_v2_hedge_budget_ms", None,
+                           round(budget_s * 1e3, 3))
+        pending = {submit(lambda j=j: fetch(j, win_off, n_cov, windows))
+                   for j in primary}
+        hedge_futs: dict = {}
+        deadline = time.monotonic() + budget_s
+        while pending:
+            if len(windows) >= k:
+                break
+            timeout = (None if hedge_futs else
+                       max(0.0, deadline - time.monotonic()))
+            _done, pending = _fwait(pending, timeout=timeout,
+                                    return_when=FIRST_COMPLETED)
+            if (not hedge_futs and pending and spares
+                    and len(windows) < k
+                    and time.monotonic() >= deadline):
+                need = min(len(pending), len(spares),
+                           k - len(windows))
+                fired = spares[:need]
+                for j in fired:
+                    def hedge(j=j):
+                        with lane_scope(BACKGROUND):
+                            return fetch(j, win_off, n_cov, windows)
+                    hedge_futs[submit(hedge)] = j
+                    METRICS2.inc("minio_tpu_v2_hedged_reads_total",
+                                 {"result": "fired"})
+                if parent_span is not None:
+                    parent_span.add_event(
+                        "ec.hedge", shards=list(fired),
+                        budget_ms=round(budget_s * 1e3, 1))
+                pending |= set(hedge_futs)
+        for f in pending:
+            f.cancel()
+        if hedge_futs:
+            # Outcome accounting for the bench's wasted-read fraction:
+            # a hedge "won" when it filled a slot a straggling primary
+            # never did; completed hedges beyond that were wasted I/O.
+            missing = sum(1 for j in primary if j not in windows)
+            won = 0
+            for f, j in hedge_futs.items():
+                if not f.done() or f.cancelled():
+                    continue
+                if j in windows and won < missing:
+                    won += 1
+                    METRICS2.inc("minio_tpu_v2_hedged_reads_total",
+                                 {"result": "won"})
+                else:
+                    METRICS2.inc("minio_tpu_v2_hedged_reads_total",
+                                 {"result": "wasted"})
+
     def _shard_readers(self, fi: FileInfo,
                        agreed: list[FileInfo | None]) -> list[int | None]:
         """Map shard index j (0-based) -> disk position, using each disk's
@@ -992,7 +1183,10 @@ class ErasureObjects:
         hsz = bitrot.hash_size(algo) if bitrot.is_streaming(algo) else 0
         stride = hsz + shard_size
         group = max(1, self.read_group_bytes // fi.erasure.block_size)
-        candidates = list(range(k)) + list(range(k, k + m))
+        # Health-ranked candidate order, computed once per part:
+        # healthy data shards first, suspect/faulty drives demoted to
+        # last resort, quarantined drives excluded (obs/drivemon.py).
+        candidates = self._read_order(by_shard, k, m)
 
         want_end = offset + length
 
@@ -1005,52 +1199,61 @@ class ErasureObjects:
         def fetch(j: int, win_off: int, n_cov: int,
                   windows: dict) -> bool:
             """Fetch shard j's window for one group; False if
-            unavailable."""
+            unavailable. Successful read durations feed the hedge
+            budget (the healthy-population percentile)."""
             if j in windows:
                 return True
             if j in failed or by_shard[j] is None:
                 return False
             disk = self.disks[by_shard[j]]
             f = agreed[by_shard[j]]
+            rel = f"{fi.name}/{f.data_dir}/part.{part_number}"
+            t0 = time.perf_counter()
             try:
                 if _read_parent is None:
-                    windows[j] = disk.read_file(
-                        fi.volume,
-                        f"{fi.name}/{f.data_dir}/part.{part_number}",
-                        win_off, n_cov * stride)
-                    return True
-                with TRACER.span("ec.shard_read", parent=_read_parent,
-                                 shard=j, endpoint=str(disk),
-                                 bytes=n_cov * stride):
-                    windows[j] = disk.read_file(
-                        fi.volume,
-                        f"{fi.name}/{f.data_dir}/part.{part_number}",
-                        win_off, n_cov * stride)
-                return True
+                    data = disk.read_file(fi.volume, rel, win_off,
+                                          n_cov * stride)
+                else:
+                    with TRACER.span("ec.shard_read",
+                                     parent=_read_parent, shard=j,
+                                     endpoint=str(disk),
+                                     bytes=n_cov * stride):
+                        data = disk.read_file(fi.volume, rel, win_off,
+                                              n_cov * stride)
             except Exception:
                 failed.add(j)
                 return False
+            self.hedge_budget.observe(time.perf_counter() - t0)
+            windows[j] = data
+            return True
 
         def fetch_group(g0: int) -> tuple:
             """Stage 1 (pipeline producer): pull one group's shard
-            windows — first-k-wins, then CONCURRENT parity fallback
-            bounded by how many shards are still missing, so a 2-lost
-            read pays one extra read RTT instead of two sequential
-            ones (ref parallelReader, cmd/erasure-decode.go:104)."""
+            windows — the k healthiest first (hedged against
+            stragglers), then CONCURRENT fallback bursts bounded by
+            how many shards are still missing, so a 2-lost read pays
+            one extra read RTT instead of two sequential ones (ref
+            parallelReader, cmd/erasure-decode.go:104)."""
             g1 = min(g0 + group - 1, end_block)
             n_cov = g1 - g0 + 1
             win_off = g0 * stride
             windows: dict[int, bytes] = {}
-            parallel_map([lambda j=j: fetch(j, win_off, n_cov, windows)
-                          for j in range(k)])
+            order = [j for j in candidates if j not in failed]
+            primary, spares = order[:k], order[k:]
+            if self.hedge_enabled and spares and len(primary) == k:
+                self._hedged_fetch(primary, spares, fetch, win_off,
+                                   n_cov, windows, k, _read_parent)
+            else:
+                parallel_map(
+                    [lambda j=j: fetch(j, win_off, n_cov, windows)
+                     for j in primary])
             have = [j for j in candidates if j in windows]
             # Known-dead shards (condemned in an earlier group, or
             # with no mapped disk) would burn the first burst's slots
             # on instant-False fetches — the burst must hold real
             # parity reads.
             rest = [j for j in candidates
-                    if j not in windows and j not in failed
-                    and by_shard[j] is not None]
+                    if j not in windows and j not in failed]
             while len(have) < k and rest:
                 burst = rest[:k - len(have)]
                 rest = rest[len(burst):]

@@ -62,6 +62,14 @@ class Healer:
 
     def __init__(self, engine):
         self.engine = engine
+        # Set by ErasureObjects.shutdown(): long sweeps (fresh-disk,
+        # post-reinstatement) run on daemon threads that outlive their
+        # trigger — they must stop at the next object boundary instead
+        # of healing a dead deployment's disks forever.
+        self._shutdown = threading.Event()
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
 
     # -- classification ------------------------------------------------
 
@@ -253,13 +261,27 @@ class Healer:
             group, parts in order, groups in order — consecutive
             groups' frames concatenate into exactly the shard stream
             the old whole-part encode produced."""
+            # Health-ranked survivors (obs/drivemon.py): read the k
+            # shards from the healthiest sources first — a suspect
+            # drive only serves a heal read when no healthier survivor
+            # can (the same any-k-of-n policy the GET path uses).
+            from ..obs.drivemon import DRIVEMON, OK as _DM_OK
+
+            def _rank(i: int) -> tuple:
+                ep = eng.endpoints[i]
+                state = DRIVEMON.state_of(ep)
+                return (1 if DRIVEMON.is_quarantined(ep) else 0,
+                        0 if state == _DM_OK else 1,
+                        DRIVEMON.ewma_for(ep).get("read", 0.0))
+
+            read_order = sorted(good_disks, key=_rank)
             for part in parts:
                 # Collect k survivor streams, tolerating read failures
                 # from disks that were "ok" at classify time but
                 # dropped since (a peer restarting mid-sweep): any k
                 # good shards decode; only fewer than k is fatal.
                 streams = {}
-                for i in good_disks:
+                for i in read_order:
                     if len(streams) == k:
                         break
                     try:
@@ -466,9 +488,13 @@ class Healer:
         results = []
         last_cost = None
         for binfo in eng.list_buckets():
+            if self._shutdown.is_set():
+                break
             bucket = binfo["name"]
             self.heal_bucket(bucket)
             for obj in eng.list_objects(bucket, max_keys=1_000_000):
+                if self._shutdown.is_set():
+                    return results
                 # Pace the sweep against foreground traffic (ref
                 # waitForLowHTTPReq + dynamicSleeper): per-object heal
                 # is I/O+hash heavy; yield ~10x the last object's own
@@ -635,22 +661,170 @@ class NewDiskMonitor:
         return swept
 
 
+class QuarantineProber:
+    """Probation probes for quarantined drives — the reinstatement half
+    of the quarantine lifecycle (obs/drivemon.py).
+
+    Every tick, each quarantined drive in the set is shadow-probed: a
+    bitrot-framed blob is staged to the drive's tmp area, read back,
+    and verified frame-exact (write path + read path + bitrot layer all
+    exercised — the three ways a sick drive lies). One clean round is a
+    probation pass; ``DriveMonitor.PROBATION_PASSES`` CONSECUTIVE
+    passes reinstate the drive; any failure restarts the streak.
+    Reinstatement kicks a background heal sweep onto the drive so the
+    writes it missed while quarantined (MRF-requeued degraded writes)
+    converge back to full redundancy.
+
+    Probe I/O rides the normal _DiskOp boundary, so the fault-injection
+    subsystem perturbs probes exactly like data-plane ops — a drive
+    whose injected faults are still active keeps failing probation.
+
+    Start contract mirrors NewDiskMonitor: the server boot starts the
+    thread; tests and library users drive tick() directly."""
+
+    PROBE_BYTES = 64 * 1024
+
+    def __init__(self, engine, interval: float = 5.0):
+        self.engine = engine
+        self.interval = interval
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self.probes = 0      # observability: probe rounds run
+        self.reinstated = 0  # observability: drives brought back
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        # mtpu-lint: disable=R1 -- boot-time probe daemon; probes carry no request context
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="quarantine-prober")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.tick()
+            except Exception:
+                import logging
+                logging.getLogger("minio_tpu.heal").exception(
+                    "quarantine prober tick failed")
+
+    def tick(self) -> list[int]:
+        """One probe round over this set's quarantined drives; returns
+        indices of drives reinstated this round."""
+        from ..obs.drivemon import DRIVEMON
+        eng = self.engine
+        reinstated = []
+        for i, disk in enumerate(eng.disks):
+            ep = eng.endpoints[i]
+            if not DRIVEMON.is_quarantined(ep):
+                continue
+            self.probes += 1
+            if self._probe(disk):
+                if DRIVEMON.probation_pass(ep):
+                    self.reinstated += 1
+                    reinstated.append(i)
+                    self._heal_after_reinstate(i)
+            else:
+                DRIVEMON.probation_fail(ep)
+        return reinstated
+
+    def _probe(self, disk) -> bool:
+        """One shadow probe: staged bitrot-framed write + read-back +
+        frame verification. Deterministic payload so a byte-level
+        mangling (injected corruption, real bitrot) is always caught."""
+        shard_size = 4096
+        payload = bytes(range(256)) * (self.PROBE_BYTES // 256)
+        framed = bitrot.encode_stream(payload, shard_size,
+                                      bitrot.DEFAULT_ALGORITHM)
+        rel = f"{TMP_PATH}/probation-probe-{uuid.uuid4().hex}"
+        try:
+            disk.write_all(MINIO_META_BUCKET, rel, framed)
+            back = disk.read_all(MINIO_META_BUCKET, rel)
+            ok = (bytes(back) == bytes(framed)
+                  and bitrot.verify_stream(back, shard_size,
+                                           bitrot.DEFAULT_ALGORITHM))
+        except Exception:
+            ok = False
+        finally:
+            try:
+                disk.delete(MINIO_META_BUCKET, rel)
+            except Exception:
+                pass
+        return ok
+
+    def _heal_after_reinstate(self, disk_index: int) -> None:
+        """Converge the writes the drive missed while quarantined: a
+        full background sweep onto it, like a fresh-disk heal (the
+        MRF entries its degraded writes queued may already be
+        drained)."""
+        import logging
+        logging.getLogger("minio_tpu.heal").info(
+            "drive %d reinstated after probation; starting heal sweep",
+            disk_index)
+
+        def run():
+            try:
+                self.engine.healer.heal_disk(disk_index)
+            except Exception:
+                logging.getLogger("minio_tpu.heal").exception(
+                    "post-reinstatement heal sweep failed")
+
+        # mtpu-lint: disable=R1 -- reinstatement sweep outlives the probe tick; heal tags its own bg lane at the call sites
+        threading.Thread(target=run, daemon=True,
+                         name=f"reinstate-heal-{disk_index}").start()
+
+
 class MRFQueue:
     """Most-recently-failed heal queue: partial PUT failures enqueue the
     object for background healing (ref mrfOpCh, cmd/erasure-object.go:1082
     + healRoutine, cmd/background-heal-ops.go:89)."""
+
+    # One drop log line per window — a full queue under a disk outage
+    # drops thousands of entries, and each dropped heal is data
+    # durability silently deferred to the next sweep; the log must say
+    # so without becoming the new bottleneck.
+    DROP_LOG_WINDOW_S = 60.0
 
     def __init__(self, healer: Healer, maxsize: int = 10_000):
         self.healer = healer
         self.q: queue.Queue = queue.Queue(maxsize=maxsize)
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
+        self.drops = 0
+        self._last_drop_log = 0.0
+
+    def depth(self) -> int:
+        return self.q.qsize()
 
     def add(self, bucket: str, object_name: str) -> None:
+        from ..obs.metrics2 import METRICS2
         try:
             self.q.put_nowait((bucket, object_name))
         except queue.Full:
-            return  # best effort, like the reference's buffered channel
+            # Best effort like the reference's buffered channel — but
+            # COUNTED: a silent drop is a heal that never happens
+            # until the next full sweep notices.
+            self.drops += 1
+            METRICS2.inc("minio_tpu_v2_mrf_drops_total")
+            now = time.monotonic()
+            if now - self._last_drop_log >= self.DROP_LOG_WINDOW_S:
+                self._last_drop_log = now
+                from ..logger import Logger
+                Logger.get().info(
+                    f"MRF queue full ({self.q.maxsize}): dropped heal "
+                    f"for {bucket}/{object_name} "
+                    f"({self.drops} drops total)", "heal")
+            return
+        METRICS2.set_gauge("minio_tpu_v2_mrf_queue_depth", None,
+                           self.q.qsize())
         # Background worker starts lazily on first failure so every
         # deployment (server, library use) gets self-healing without
         # explicit wiring.
@@ -712,8 +886,11 @@ class MRFQueue:
             pass  # background best-effort
 
     def _run(self) -> None:
+        from ..obs.metrics2 import METRICS2
         while not self._stop.is_set():
             item = self.q.get()
+            METRICS2.set_gauge("minio_tpu_v2_mrf_queue_depth", None,
+                               self.q.qsize())
             if item is None or self._stop.is_set():
                 break
             self._heal(item)

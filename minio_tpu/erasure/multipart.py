@@ -143,6 +143,10 @@ class MultipartUploads:
         md5 = None if hasattr(reader, "etag") else hashlib.md5()
         alive = [True] * n
         disk_errs: list = [None] * n
+        # Degraded write past quarantined drives (same policy as a
+        # single PUT; the completed object's missing shards heal via
+        # the engine's MRF requeue at complete time).
+        eng._quarantine_skip(alive, disk_errs, wq)
 
         def cleanup(indices):
             parallel_map([

@@ -27,6 +27,12 @@ class ErasureServerPools:
     def m(self) -> int:
         return self.pools[0].m
 
+    def shutdown(self) -> None:
+        """Stop every pool's background daemons (see
+        ErasureObjects.shutdown)."""
+        for p in self.pools:
+            p.shutdown()
+
     # -- placement ------------------------------------------------------
 
     def _pool_free_space(self, pool: ErasureSets) -> int:

@@ -61,6 +61,12 @@ class ErasureSets:
     def set_for(self, object_name: str) -> ErasureObjects:
         return self.sets[self.set_index(object_name)]
 
+    def shutdown(self) -> None:
+        """Stop every set's background daemons (see
+        ErasureObjects.shutdown)."""
+        for s in self.sets:
+            s.shutdown()
+
     # -- buckets (fan out to every set) ---------------------------------
 
     def make_bucket(self, bucket: str) -> None:
@@ -175,7 +181,6 @@ class ErasureSets:
     @property
     def healer(self):
         return _SetsHealer(self)
-
 
 class _SetsMultipart:
     def __init__(self, sets: ErasureSets):

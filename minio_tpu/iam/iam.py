@@ -67,6 +67,37 @@ class ConfigStore:
     def __init__(self, disks: list):
         self.disks = disks
 
+    def _first_success(self, read):
+        """Run ``read(disk)`` against healthy disks first, quarantined
+        only as a last resort — config reads obey the same hygiene as
+        the data plane (obs/drivemon.py quarantine lifecycle). A
+        healthy disk answering "not found" is a DEFINITIVE miss
+        (config docs are optional — most never exist), so only
+        transient failures on every healthy disk justify probing a
+        possibly-stalling quarantined drive (availability over
+        hygiene). Returns the first successful read, or None."""
+        from ..obs.drivemon import DRIVEMON, drive_key
+        healthy: list = []
+        quarantined: list = []
+        for d in self.disks:
+            (quarantined if DRIVEMON.is_quarantined(drive_key(d))
+             else healthy).append(d)
+        definitive_miss = False
+        for d in healthy:
+            try:
+                return read(d)
+            except (serr.FileNotFound, serr.VolumeNotFound):
+                definitive_miss = True
+            except serr.StorageError:
+                continue
+        if not definitive_miss:
+            for d in quarantined:
+                try:
+                    return read(d)
+                except serr.StorageError:
+                    continue
+        return None
+
     def save(self, path: str, doc: dict) -> None:
         raw = json.dumps(doc, sort_keys=True).encode()
         _, errs = parallel_map(
@@ -77,25 +108,18 @@ class ConfigStore:
             raise serr.FaultyDisk(f"config write quorum failed: {path}")
 
     def load(self, path: str) -> dict | None:
-        for d in self.disks:
-            try:
-                return json.loads(d.read_all(MINIO_META_BUCKET, path))
-            except serr.StorageError:
-                continue
-        return None
+        return self._first_success(
+            lambda d: json.loads(d.read_all(MINIO_META_BUCKET, path)))
 
     def delete(self, path: str) -> None:
         parallel_map([lambda d=d: d.delete(MINIO_META_BUCKET, path)
                       for d in self.disks])
 
     def list(self, prefix: str) -> list[str]:
-        for d in self.disks:
-            try:
-                return [e for e in d.list_dir(MINIO_META_BUCKET, prefix)
-                        if not e.endswith("/")]
-            except serr.StorageError:
-                continue
-        return []
+        out = self._first_success(
+            lambda d: [e for e in d.list_dir(MINIO_META_BUCKET, prefix)
+                       if not e.endswith("/")])
+        return [] if out is None else out
 
 
 class IAMSys:

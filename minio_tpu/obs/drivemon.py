@@ -65,6 +65,15 @@ _BENIGN = (serr.FileNotFound, serr.VersionNotFound, serr.VolumeNotFound,
            serr.VolumeExists, FileNotFoundError, IsADirectoryError,
            NotADirectoryError, FileExistsError)
 
+# Connectivity loss is the TRANSPORT's failure domain, not the drive's:
+# DiskNotFound is what a peer's drives surface while the peer is
+# offline (rpc/transport.py health gate). Counting it as drive-fault
+# evidence would quarantine every drive of a rebooting node — and
+# probation (bitrot shadow probes) would then hold its WRITES off for
+# whole probe windows after the peer is already back, while the
+# transport gate re-opens in seconds. Media evidence only.
+_CONNECTIVITY = (serr.DiskNotFound,)
+
 
 def is_drive_fault(exc) -> bool:
     """True when an exception (instance or type) is evidence of a bad
@@ -72,10 +81,10 @@ def is_drive_fault(exc) -> bool:
     if exc is None:
         return False
     if isinstance(exc, type):
-        if issubclass(exc, _BENIGN):
+        if issubclass(exc, _BENIGN + _CONNECTIVITY):
             return False
         return exc.__name__ != "DeadlineExceeded"
-    if isinstance(exc, _BENIGN):
+    if isinstance(exc, _BENIGN + _CONNECTIVITY):
         return False
     return type(exc).__name__ != "DeadlineExceeded"
 
@@ -84,11 +93,22 @@ OK, SUSPECT, FAULTY = "ok", "suspect", "faulty"
 _STATE_VALUE = {OK: 0, SUSPECT: 1, FAULTY: 2}
 
 
+def drive_key(disk) -> str:
+    """Canonical health identity for a disk object (local XLStorage,
+    RemoteStorage, or a duck-typed test double): the key every
+    data-plane boundary records under and every health consumer —
+    read selection, quarantine gates, config stores — queries by."""
+    try:
+        return disk.endpoint()
+    except Exception:
+        return str(disk)
+
+
 class _Drive:
     __slots__ = ("endpoint", "set_id", "state", "ewma", "win_lat",
                  "win_ops", "win_errs", "hot_windows", "err_windows",
                  "ops_total", "errs_total", "windows", "changed_at",
-                 "last_score", "mu")
+                 "last_score", "mu", "quarantined", "probation_passes")
 
     def __init__(self, endpoint: str, set_id: int):
         # PER-DRIVE lock: the record() hot path runs inside quorum
@@ -112,6 +132,12 @@ class _Drive:
         self.windows = 0
         self.changed_at = 0.0
         self.last_score = 0.0
+        # Quarantine lifecycle (set on entering FAULTY when
+        # AUTO_QUARANTINE): the data plane excludes this drive from
+        # read selection and write fan-out; window scoring freezes
+        # until probation probes reinstate it.
+        self.quarantined = False
+        self.probation_passes = 0
 
 
 class DriveMonitor:
@@ -150,6 +176,14 @@ class DriveMonitor:
     # Peers needed (with data for the op class) before outlier scoring
     # engages — a lone drive has no one to be an outlier against.
     MIN_PEERS = 2
+    # Entering FAULTY auto-quarantines the drive: the data plane stops
+    # reading from / writing to it (erasure/engine.py consults
+    # is_quarantined), and only probation probes can bring it back.
+    AUTO_QUARANTINE = True
+    # Consecutive probation probe rounds (shadow read + bitrot verify,
+    # erasure/heal.py QuarantineProber) that must pass before a
+    # quarantined drive rejoins the read/write set.
+    PROBATION_PASSES = 3
 
     def __init__(self):
         self.enabled = True
@@ -246,11 +280,20 @@ class DriveMonitor:
         d.win_lat = {}
         d.win_ops = 0
         d.win_errs = 0
+        if d.quarantined:
+            # Frozen: a quarantined drive sees only probe/heal traffic,
+            # and a quiet window of THAT must not silently clear the
+            # state — reinstatement is the probation prober's decision
+            # (bitrot-verified shadow reads), never a scoring artifact.
+            return None
         new_state = OK
         if d.err_windows >= self.FAULTY_WINDOWS:
             new_state = FAULTY
         elif d.hot_windows >= self.SUSPECT_WINDOWS:
             new_state = SUSPECT
+        if new_state == FAULTY and self.AUTO_QUARANTINE:
+            d.quarantined = True
+            d.probation_passes = 0
         if new_state == d.state:
             return None
         old, d.state = d.state, new_state
@@ -282,21 +325,104 @@ class DriveMonitor:
         from ..logger import Logger
         from .metrics2 import METRICS2
         from .span import current_span
+        quarantined = self.is_quarantined(endpoint)
+        note = " [quarantined]" if quarantined else ""
         Logger.get().info(
-            f"drivemon: {endpoint} {old} -> {new} "
+            f"drivemon: {endpoint} {old} -> {new}{note} "
             f"(peer-relative score {score}x)", "drivemon")
         red = redacted_endpoint(endpoint)
         METRICS2.set_gauge("minio_tpu_v2_drive_state",
                            {"disk": red}, _STATE_VALUE[new])
         METRICS2.inc("minio_tpu_v2_drive_state_transitions_total",
                      {"disk": red, "state": new})
+        if quarantined and new == FAULTY:
+            METRICS2.inc("minio_tpu_v2_drive_quarantines_total",
+                         {"disk": red})
         for cls, v in self.ewma_for(endpoint).items():
             METRICS2.set_gauge("minio_tpu_v2_drive_op_latency_ewma_ms",
                                {"disk": red, "op_class": cls}, v)
         span = current_span()
         if span is not None:
             span.add_event("drive.state", disk=endpoint, state=new,
-                           score=score)
+                           score=score, quarantined=quarantined)
+
+    # -- quarantine / probation lifecycle ------------------------------
+
+    def is_quarantined(self, endpoint: str) -> bool:
+        """Lock-free hot-path check (GIL-atomic dict/attr reads); the
+        read/write selection paths call this per drive per request."""
+        d = self._drives.get(endpoint)
+        return d is not None and d.quarantined
+
+    def quarantined_endpoints(self) -> list[str]:
+        with self._mu:
+            return [ep for ep, d in sorted(self._drives.items())
+                    if d.quarantined]
+
+    def quarantine(self, endpoint: str, reason: str = "manual") -> None:
+        """Force a drive into quarantine (the FAULTY auto-path runs
+        through _close_window; this is the explicit entry for admin /
+        test use)."""
+        with self._mu:
+            d = self._drives.get(endpoint)
+            if d is None or d.quarantined:
+                return
+            old = d.state
+            with d.mu:
+                d.quarantined = True
+                d.probation_passes = 0
+                d.state = FAULTY
+                d.changed_at = time.time()
+        self._announce(endpoint, old, FAULTY, 0.0)
+
+    def probation_pass(self, endpoint: str) -> bool:
+        """One successful probation probe (shadow read passed bitrot
+        verification). Returns True when the drive just crossed
+        PROBATION_PASSES and was reinstated."""
+        from .metrics2 import METRICS2
+        METRICS2.inc("minio_tpu_v2_drive_probation_probes_total",
+                     {"result": "pass"})
+        with self._mu:
+            d = self._drives.get(endpoint)
+            if d is None or not d.quarantined:
+                return False
+            d.probation_passes += 1
+            if d.probation_passes < self.PROBATION_PASSES:
+                return False
+        self.reinstate(endpoint)
+        return True
+
+    def probation_fail(self, endpoint: str) -> None:
+        """A probation probe failed: the streak restarts."""
+        from .metrics2 import METRICS2
+        METRICS2.inc("minio_tpu_v2_drive_probation_probes_total",
+                     {"result": "fail"})
+        with self._mu:
+            d = self._drives.get(endpoint)
+            if d is not None:
+                d.probation_passes = 0
+
+    def reinstate(self, endpoint: str) -> None:
+        """Probation passed: the drive rejoins the read/write set with
+        a clean slate (EWMAs kept — they decay naturally; counters
+        that drive state transitions reset so one old error window
+        cannot instantly re-quarantine a healthy drive)."""
+        with self._mu:
+            d = self._drives.get(endpoint)
+            if d is None or not d.quarantined:
+                return
+            old = d.state
+            with d.mu:
+                d.quarantined = False
+                d.probation_passes = 0
+                d.err_windows = 0
+                d.hot_windows = 0
+                d.win_lat = {}
+                d.win_ops = 0
+                d.win_errs = 0
+                d.state = OK
+                d.changed_at = time.time()
+        self._announce(endpoint, old, OK, 0.0)
 
     # -- reads ---------------------------------------------------------
 
@@ -329,6 +455,8 @@ class DriveMonitor:
                     "endpoint": ep,
                     "set": d.set_id,
                     "state": d.state,
+                    "quarantined": d.quarantined,
+                    "probationPasses": d.probation_passes,
                     "opsTotal": d.ops_total,
                     "errsTotal": d.errs_total,
                     "windows": d.windows,
@@ -341,7 +469,9 @@ class DriveMonitor:
                 })
             suspect = sum(1 for x in drives if x["state"] == SUSPECT)
             faulty = sum(1 for x in drives if x["state"] == FAULTY)
-        return {"drives": drives, "suspect": suspect, "faulty": faulty}
+            quarantined = sum(1 for x in drives if x["quarantined"])
+        return {"drives": drives, "suspect": suspect, "faulty": faulty,
+                "quarantined": quarantined}
 
     def reset(self) -> None:
         with self._mu:

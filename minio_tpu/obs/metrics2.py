@@ -332,6 +332,31 @@ METRICS2.register(
     "Drive op errors (real disk faults, not namespace misses), "
     "by disk endpoint and op class.")
 METRICS2.register(
+    "minio_tpu_v2_drive_quarantines_total", "counter",
+    "Drives auto-quarantined by the health monitor, by disk endpoint.")
+METRICS2.register(
+    "minio_tpu_v2_drive_probation_probes_total", "counter",
+    "Probation probe rounds on quarantined drives (shadow read + "
+    "bitrot verify), by result (pass/fail).")
+METRICS2.register(
+    "minio_tpu_v2_hedged_reads_total", "counter",
+    "Hedged shard reads, by result: fired (backup read launched past "
+    "the straggler budget), won (the hedge substituted a straggler), "
+    "wasted (the primary answered anyway).")
+METRICS2.register(
+    "minio_tpu_v2_hedge_budget_ms", "gauge",
+    "Current adaptive straggler budget for hedged shard reads.")
+METRICS2.register(
+    "minio_tpu_v2_mrf_drops_total", "counter",
+    "Heal requests dropped because the MRF queue was full.")
+METRICS2.register(
+    "minio_tpu_v2_mrf_queue_depth", "gauge",
+    "Objects waiting in the most-recently-failed heal queue.")
+METRICS2.register(
+    "minio_tpu_v2_fault_injections_total", "counter",
+    "Faults injected by the runtime fault-injection subsystem, "
+    "by kind.")
+METRICS2.register(
     "minio_tpu_v2_slow_requests_total", "counter",
     "Requests captured by the slow-request log, by API class and "
     "blamed layer.")

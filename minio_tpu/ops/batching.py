@@ -258,6 +258,11 @@ def reconstruct_blocks(blocks: list[list[np.ndarray | None]], k: int,
         with qos_sched.GATE.dispatch(lane):
             if use_device(stack.nbytes):
                 try:
+                    # Kernel-dispatch fault hook (minio_tpu/faultinject):
+                    # an injected failure lands inside this try so the
+                    # host-fallback lane below is what gets exercised.
+                    from ..faultinject import FAULTS
+                    FAULTS.kernel("rs_decode")
                     rebuilt = _device_reconstruct(stack, k, m, avail,
                                                   missing)
                     STATS.add(True, stack.nbytes, len(idxs))
@@ -442,6 +447,11 @@ class EncodeCoalescer:
                 continue
             try:
                 from . import rs_tpu
+                # Kernel-dispatch fault hook (minio_tpu/faultinject):
+                # raising here declines the batch back to the callers'
+                # host-encode lane — the failover under test.
+                from ..faultinject import FAULTS
+                FAULTS.kernel("rs_encode")
                 stack = (reqs[0].blocks if len(reqs) == 1 else
                          np.concatenate([r.blocks for r in reqs], axis=0))
                 encoded = rs_tpu.encode_batch(stack, k, m)

@@ -243,13 +243,21 @@ class RemoteStorage(StorageAPI):
                    bytes(data))
 
     def read_all(self, volume, path):
-        return self._call("read_all", {"volume": volume,
+        data = self._call("read_all", {"volume": volume,
                                        "path": path})[1]
+        # Corrupt-over-the-wire injection (minio_tpu/faultinject):
+        # keyed by the remote drive identity so a plan can rot ONE
+        # peer disk's reads — the caller's bitrot verification must
+        # catch it exactly like on-platter rot.
+        from ..faultinject import FAULTS
+        return FAULTS.filter_read(self._drive_key(), "read_all", data)
 
     def read_file(self, volume, path, offset, length):
-        return self._call("read_file", {"volume": volume, "path": path,
+        data = self._call("read_file", {"volume": volume, "path": path,
                                         "offset": offset,
                                         "length": length})[1]
+        from ..faultinject import FAULTS
+        return FAULTS.filter_read(self._drive_key(), "read_file", data)
 
     def create_file(self, volume, path, data):
         if isinstance(data, (bytes, bytearray, memoryview)):

@@ -22,6 +22,7 @@ import hashlib
 import hmac
 import http.client
 import json
+import random
 import struct
 import socket
 import threading
@@ -95,7 +96,16 @@ class RPCClient:
     connection."""
 
     # Seconds a peer stays marked offline before a reconnect probe.
+    # Live-reloadable via config-KV `rpc offline_retry=` (the server's
+    # apply hook rewrites the CLASS attribute, so every client in the
+    # process follows without reconstruction).
     OFFLINE_RETRY = 2.0
+    # Reconnect-probe jitter: each offline window is stretched by a
+    # random factor in [1, 1 + OFFLINE_JITTER] so a restarted peer
+    # sees the cluster's reconnect probes SPREAD over the window
+    # instead of a thundering herd at the exact same instant (every
+    # node marked it offline within the same failed fan-out).
+    OFFLINE_JITTER = 0.5
 
     def __init__(self, host: str, port: int, cluster_key: bytes,
                  timeout: float = 30.0, tls=None):
@@ -123,8 +133,10 @@ class RPCClient:
         return time.monotonic() >= self._offline_until
 
     def _mark_offline(self) -> None:
+        window = self.OFFLINE_RETRY * (
+            1.0 + self.OFFLINE_JITTER * random.random())
         with self._mu:
-            self._offline_until = time.monotonic() + self.OFFLINE_RETRY
+            self._offline_until = time.monotonic() + window
 
     @property
     def timeout(self) -> float:
@@ -180,6 +192,20 @@ class RPCClient:
         can never knock a healthy peer out of the data plane."""
         if not self.is_online():
             raise serr.DiskNotFound(f"{self.endpoint()} offline")
+        # Per-peer wire faults (minio_tpu/faultinject): an injected
+        # partition behaves exactly like an unreachable peer — the
+        # health gate closes and reconnect probes (with jitter) take
+        # over; slow-wire adds latency ahead of the socket I/O.
+        from ..faultinject import FAULTS
+        if FAULTS.enabled:
+            _lat, _part = FAULTS.peer(self.endpoint())
+            if _lat:
+                time.sleep(_lat)
+            if _part:
+                self._mark_offline()
+                raise serr.DiskNotFound(
+                    f"{self.endpoint()} unreachable: injected "
+                    "partition")
         # Deadline propagation (qos/deadline.py): a request whose
         # budget is already spent must not burn peer capacity — fail
         # here. Otherwise forward the REMAINING budget so the peer can
@@ -220,6 +246,7 @@ class RPCClient:
             headers["x-mtpu-trace"] = f"{_cur.trace_id}:{_cur.span_id}"
         override = timeout is not None
         conn, reused = self._get_conn(eff_timeout)
+        # mtpu-lint: disable=R6 -- single-shot retry, not a loop: the continue requires reused=True and a fresh socket comes back reused=False, so it fires at most once; no backoff by design (a stale pool is instant-fail, the peer is healthy)
         while True:
             t0 = time.monotonic()
             logged = override

@@ -642,10 +642,40 @@ class AdminHandlers:
         return out
 
     def h_drive_health(self, p, body):
-        """Admin view of the drive-health monitor (same payload as the
-        unauthenticated /minio-tpu/v2/health/drives node endpoint)."""
+        """Admin view of the drive-health monitor (same shape as the
+        unauthenticated /minio-tpu/v2/health/drives node endpoint, but
+        with FULL drive endpoints — this surface is root-only)."""
         from ..obs.drivemon import DRIVEMON
-        return DRIVEMON.snapshot()
+        out = DRIVEMON.snapshot()
+        out["mrf"] = self.server._mrf_stats()
+        return out
+
+    # -- runtime fault injection (minio_tpu/faultinject) ---------------
+
+    def h_fault_inject(self, p, body):
+        """Manage the runtime fault-injection plan.
+
+        POST with a JSON plan body loads (replaces) the plan;
+        ``?clear=true`` clears it; a bare GET/POST returns the active
+        plan with per-rule seen/fired counters — the scenario
+        matrices in tests/test_fault_harness.py drive exactly this
+        surface."""
+        from ..faultinject import FAULTS, FaultPlanError
+        if p.get("clear") == "true":
+            FAULTS.clear()
+            return {"ok": True, "active": False}
+        if body:
+            try:
+                doc = json.loads(body)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"fault plan: {e}")
+            try:
+                FAULTS.load_plan(doc)
+            except FaultPlanError as e:
+                raise ValueError(str(e))
+            return {"ok": True, "active": FAULTS.enabled,
+                    "rules": len(doc.get("rules", []))}
+        return FAULTS.snapshot()
 
     # -- locks ----------------------------------------------------------
 

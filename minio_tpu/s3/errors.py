@@ -206,9 +206,10 @@ ERR_OBJECT_CORRUPT = _e(
 # so the safety net stays total as the taxonomy grows. (storage/errors
 # imports nothing, so this import cannot cycle.)
 from ..storage.errors import (DiskFull, DiskNotFound,  # noqa: E402
-                              FaultyDisk, FileCorrupt, FileNotFound,
-                              StorageError, VersionNotFound,
-                              VolumeExists, VolumeNotFound)
+                              DriveQuarantined, FaultyDisk, FileCorrupt,
+                              FileNotFound, StorageError,
+                              VersionNotFound, VolumeExists,
+                              VolumeNotFound)
 
 STORAGE_ERROR_MAP = {
     StorageError: ERR_INTERNAL_ERROR,
@@ -220,6 +221,9 @@ STORAGE_ERROR_MAP = {
     VersionNotFound: ERR_NO_SUCH_VERSION,
     FileCorrupt: ERR_OBJECT_CORRUPT,
     DiskFull: ERR_STORAGE_FULL,
+    # A quarantine marker surfacing alone means the engine could not
+    # find enough healthy drives either — retryable unavailability.
+    DriveQuarantined: ERR_SLOW_DOWN,
 }
 
 

@@ -2249,6 +2249,33 @@ class S3Server:
                         raise ValueError(
                             f"obs profile_on_slow={v!r}: must be "
                             "on/off")
+        if subsys == "rpc":
+            from ..qos.deadline import parse_duration
+            for key, v in kvs.items():
+                if key == "offline_retry":
+                    try:
+                        if parse_duration(v) <= 0:
+                            raise ValueError
+                    except ValueError:
+                        raise ValueError(
+                            f"rpc offline_retry={v!r}: must be a "
+                            "positive duration like 2s / 500ms")
+        if subsys == "fault_inject":
+            for key, v in kvs.items():
+                if key == "enable":
+                    if v not in ("on", "off"):
+                        raise ValueError(
+                            f"fault_inject enable={v!r}: must be "
+                            "on/off")
+                elif key == "plan" and v.strip():
+                    import json as _json
+                    from ..faultinject import FAULTS, FaultPlanError
+                    try:
+                        FAULTS.validate(_json.loads(v))
+                    except (_json.JSONDecodeError,
+                            FaultPlanError) as e:
+                        raise ValueError(
+                            f"fault_inject plan: {e}")
         if subsys == "api":
             from ..qos.deadline import parse_duration
             for key, v in kvs.items():
@@ -2305,6 +2332,43 @@ class S3Server:
             from ..logger import Logger
             Logger.get().log_once(
                 f"api qos config invalid, keeping previous: {e}", "config")
+        # Peer health-gate window reloads live on the CLASS, so every
+        # RPC client in the process follows (rpc/transport.py).
+        from ..qos.deadline import parse_duration as _pd
+        from ..rpc.transport import RPCClient
+        try:
+            _retry = _pd(cfg.get("rpc", "offline_retry"))
+            # Env overrides bypass _validate: a zero here would
+            # disable the peer health gate entirely (every RPC to a
+            # dead peer pays the full socket timeout).
+            if _retry <= 0:
+                raise ValueError(f"offline_retry={_retry!r}: must be "
+                                 "positive")
+            RPCClient.OFFLINE_RETRY = _retry
+        except ValueError as e:  # env override may carry garbage
+            from ..logger import Logger
+            Logger.get().log_once(
+                f"rpc config invalid, keeping previous: {e}", "config")
+        # Fault-injection plan: applied only when the EFFECTIVE
+        # fault_inject config changed — the apply hook runs on every
+        # config write, and an unrelated change must not clobber a
+        # plan loaded through the admin /fault-inject API.
+        fcfg = (cfg.get("fault_inject", "enable"),
+                cfg.get("fault_inject", "plan"))
+        if fcfg != getattr(self, "_last_fault_cfg", ("off", "")):
+            self._last_fault_cfg = fcfg
+            from ..faultinject import FAULTS
+            try:
+                if fcfg[0] == "on" and fcfg[1].strip():
+                    import json as _json
+                    FAULTS.load_plan(_json.loads(fcfg[1]))
+                else:
+                    FAULTS.clear()
+            except Exception as e:  # env override may carry garbage
+                from ..logger import Logger
+                Logger.get().log_once(
+                    f"fault_inject config invalid, ignored: {e}",
+                    "config")
         # Slowlog SLO thresholds reload live (the always-on tail
         # capture must be tunable under fire, like the QoS caps).
         from ..obs.slowlog import SLOWLOG
@@ -2791,9 +2855,13 @@ class S3Server:
             # `mc admin obd`; here continuously tracked, not probed).
             # UNAUTHENTICATED like the metrics pages, so endpoints are
             # redacted — full paths are on the admin /drive-health.
+            # The MRF heal-queue census rides along: queue depth +
+            # drops are the "how far behind is healing" signal that
+            # belongs next to the drive states.
             from ..obs.drivemon import DRIVEMON, redact_drives
-            return 200, "application/json", _json.dumps(
-                redact_drives(DRIVEMON.snapshot())).encode()
+            doc = redact_drives(DRIVEMON.snapshot())
+            doc["mrf"] = self._mrf_stats()
+            return 200, "application/json", _json.dumps(doc).encode()
         if raw_path == "/minio-tpu/v2/health/cluster/drives":
             return self._health_cluster_drives()
         if raw_path in ("/minio-tpu/console", "/minio-tpu/console/") \
@@ -2962,6 +3030,20 @@ class S3Server:
         body = self._cached_cluster_scrape("_cluster_drives_cache",
                                            build)
         return 200, "application/json", body
+
+    def _mrf_stats(self) -> dict:
+        """MRF heal-queue census across this node's erasure sets
+        (depth + drop count; see erasure/heal.py MRFQueue)."""
+        from .admin import _pools
+        depth = drops = 0
+        if self.layer is not None:
+            for pool in _pools(self.layer):
+                for es in pool.sets:
+                    mrf = getattr(es, "mrf", None)
+                    if mrf is not None:
+                        depth += mrf.depth()
+                        drops += mrf.drops
+        return {"depth": depth, "drops": drops}
 
     def _cluster_healthy(self) -> bool:
         """Quorum-aware cluster check (ref ClusterCheckHandler,
@@ -3534,6 +3616,13 @@ class S3Server:
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
+        # Stop the layer's background daemons (MRF heal worker, disk
+        # monitors, quarantine prober) — a stopped server's daemons
+        # must not keep churning its disks (tests run many servers per
+        # process; leaked healers steal CPU from everything after).
+        layer_shutdown = getattr(self.layer, "shutdown", None)
+        if callable(layer_shutdown):
+            layer_shutdown()
         if self.notifier is not None:
             self.notifier.close()
         if self.handlers is not None:

@@ -35,3 +35,9 @@ class FileCorrupt(StorageError):
 
 class DiskFull(StorageError):
     """No space left (ref errDiskFull)."""
+
+
+class DriveQuarantined(StorageError):
+    """Write/read skipped because the drive is quarantined by the
+    health monitor (obs/drivemon.py) — a bookkeeping marker for the
+    degraded-write path, not evidence from the drive itself."""

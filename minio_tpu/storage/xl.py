@@ -23,6 +23,7 @@ from . import errors as serr
 from .interface import StorageAPI
 from .metadata import XL_META_FILE, FileInfo, XLMeta
 from ..erasure import bitrot
+from ..faultinject import FAULTS
 from ..obs.drivemon import DRIVEMON, is_drive_fault
 from ..obs.metrics2 import METRICS2
 from ..obs.span import TRACER
@@ -46,12 +47,17 @@ class _DiskOp:
     def __enter__(self):
         self._t0 = time.perf_counter()
         self._cm.__enter__()
-        # Fault-injection hook (tests/fault harness): a latency-
-        # wrapping shim sets fault_latency_s so the injected delay
-        # lands INSIDE the measured op window — exactly what a slow
-        # physical drive looks like to the monitor.
-        if self._disk.fault_latency_s:
-            time.sleep(self._disk.fault_latency_s)
+        # Fault-injection hook (minio_tpu/faultinject): injected
+        # latency sleeps — and injected errors raise — INSIDE the
+        # measured op window, exactly what a degraded physical drive
+        # looks like to the monitor. A raise must still close the
+        # span and feed the drive-health error accounting, so it is
+        # routed through our own __exit__ before propagating.
+        try:
+            FAULTS.disk_op(self._disk.root, self.op)
+        except BaseException as e:
+            self.__exit__(type(e), e, e.__traceback__)
+            raise
         return self
 
     def __exit__(self, *exc):
@@ -78,10 +84,6 @@ def _is_valid_volume(volume: str) -> bool:
 
 
 class XLStorage(StorageAPI):
-    # Injected per-op latency (seconds) applied inside _DiskOp's
-    # measured window — the fault harness's slow-drive shim knob.
-    fault_latency_s = 0.0
-
     def __init__(self, root: str):
         self.root = os.path.abspath(root)
         self.disk_id = ""
@@ -256,15 +258,19 @@ class XLStorage(StorageAPI):
     def write_all(self, volume: str, path: str, data: bytes) -> None:
         # Volume check happens in _makedirs_for, adjacent to the mkdir.
         with _DiskOp("write_all", self):
-            self._atomic_write(self._file_path(volume, path),
-                               bytes(data), volume=volume)
+            self._atomic_write(
+                self._file_path(volume, path),
+                FAULTS.filter_write(self.root, "write_all",
+                                    bytes(data)),
+                volume=volume)
 
     def read_all(self, volume: str, path: str) -> bytes:
         self._check_vol(volume)
         full = self._file_path(volume, path)
         try:
             with _DiskOp("read_all", self), open(full, "rb") as f:
-                return f.read()
+                return FAULTS.filter_read(self.root, "read_all",
+                                          f.read())
         except FileNotFoundError:
             raise serr.FileNotFound(f"{volume}/{path}")
         except IsADirectoryError:
@@ -279,7 +285,8 @@ class XLStorage(StorageAPI):
         try:
             with _DiskOp("read_file", self), open(full, "rb") as f:
                 f.seek(offset)
-                return f.read(length)
+                return FAULTS.filter_read(self.root, "read_file",
+                                          f.read(length))
         except FileNotFoundError:
             raise serr.FileNotFound(f"{volume}/{path}")
         except OSError as e:
@@ -295,7 +302,11 @@ class XLStorage(StorageAPI):
         full = self._file_path(volume, path)
         if isinstance(data, (bytes, bytearray, memoryview)):
             with _DiskOp("create_file", self):
-                self._atomic_write(full, bytes(data), volume=volume)
+                self._atomic_write(
+                    full,
+                    FAULTS.filter_write(self.root, "create_file",
+                                        bytes(data)),
+                    volume=volume)
             return
         self._makedirs_for(volume, os.path.dirname(full))
         try:
@@ -309,6 +320,7 @@ class XLStorage(StorageAPI):
 
     def append_file(self, volume: str, path: str, data: bytes) -> None:
         full = self._file_path(volume, path)
+        data = FAULTS.filter_write(self.root, "append_file", data)
         try:
             with _DiskOp("append_file", self):
                 try:

@@ -193,15 +193,22 @@ def test_dist_lock_over_rpc(cluster):
     eng1 = nodes[1].layer.pools[0].sets[0]
     order = []
 
+    acquired = threading.Event()
+
     def hold():
         with eng0.ns_lock.write_locked("b", "o"):
             order.append("n0-acquired")
+            acquired.set()
             time.sleep(0.4)
             order.append("n0-released")
 
     t = threading.Thread(target=hold)
     t.start()
-    time.sleep(0.1)
+    # Wait for the FACT of n0's acquisition, not a fixed grace: under
+    # full-suite load on a slow box the RPC-backed acquire can take
+    # longer than any sleep we'd pick, and n1 sneaking in first
+    # inverts the order this test asserts.
+    assert acquired.wait(5)
     with eng1.ns_lock.write_locked("b", "o", timeout=5):
         order.append("n1-acquired")
     t.join()

@@ -1,0 +1,275 @@
+"""Fault-injection subsystem tests (minio_tpu/faultinject): plan
+validation, deterministic decisions, and the hook points' end-to-end
+behavior — injected corruption is caught by bitrot verification,
+torn writes reconstruct from parity, partitions close the peer health
+gate, kernel faults exercise the host-fallback lane."""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from minio_tpu.faultinject import (FAULTS, FaultInjector, FaultPlanError,
+                                   InjectedFault)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    FAULTS.clear()
+    yield
+    FAULTS.clear()
+
+
+# ---------------------------------------------------------------------------
+# plan validation + determinism
+
+
+def test_plan_validation_rejects_malformed_docs():
+    for doc in (
+        [],                                        # not an object
+        {"rules": {}},                             # rules not a list
+        {"rules": [{"kind": "nope"}]},             # unknown kind
+        {"rules": [{"kind": "latency", "zap": 1}]},  # unknown field
+        {"rules": [{"kind": "error", "probability": 2.0}]},
+        {"rules": [{"kind": "error", "count": -1}]},
+        {"bogus": 1},                              # unknown plan field
+    ):
+        with pytest.raises(FaultPlanError):
+            FaultInjector.validate(doc)
+    assert FaultInjector.validate({"seed": 1, "rules": []}) == []
+
+
+def test_probability_decisions_are_seed_deterministic():
+    def pattern(seed: int) -> list[bool]:
+        inj = FaultInjector()
+        inj.load_plan({"seed": seed, "rules": [
+            {"kind": "error", "target": "/d", "probability": 0.5}]})
+        out = []
+        for _ in range(40):
+            try:
+                inj.disk_op("/d", "read_all")
+                out.append(False)
+            except Exception:
+                out.append(True)
+        return out
+
+    a, b = pattern(11), pattern(11)
+    assert a == b, "same seed must give the same fire pattern"
+    assert a != pattern(12), "a different seed must differ"
+    assert 5 < sum(a) < 35, "p=0.5 should fire roughly half the time"
+
+
+def test_after_and_count_bound_the_fire_window():
+    inj = FaultInjector()
+    inj.load_plan({"rules": [
+        {"kind": "error", "target": "/d", "after": 3, "count": 2}]})
+    fired = []
+    for i in range(10):
+        try:
+            inj.disk_op("/d", "read_all")
+        except Exception:
+            fired.append(i)
+    assert fired == [3, 4]
+    snap = inj.snapshot()
+    assert snap["rules"][0]["seen"] == 10
+    assert snap["rules"][0]["fired"] == 2
+
+
+def test_target_and_op_filters():
+    inj = FaultInjector()
+    inj.load_plan({"rules": [
+        {"kind": "error", "target": "/disks/d1", "op": "read"}]})
+    # Other drive: untouched. Write op-class on the target: untouched.
+    inj.disk_op("/disks/d2", "read_all")
+    inj.disk_op("/disks/d1", "write_all")
+    with pytest.raises(Exception):
+        inj.disk_op("/disks/d1", "read_all")  # class match
+    with pytest.raises(Exception):
+        inj.disk_op("/disks/d1", "read_file")
+
+
+def test_filters_mangle_payloads_only_when_fired():
+    inj = FaultInjector()
+    data = bytes(range(200))
+    assert inj.filter_read("/d", "read_all", data) == data  # no plan
+    inj.load_plan({"rules": [
+        {"kind": "corrupt", "target": "/d", "op": "read"},
+        {"kind": "torn_write", "target": "/d"}]})
+    rotten = inj.filter_read("/d", "read_all", data)
+    assert rotten != data and len(rotten) == len(data)
+    torn = inj.filter_write("/d", "append_file", data)
+    assert torn == data[:100]
+    assert inj.filter_read("/other", "read_all", data) == data
+
+
+def test_kernel_hook_raises_only_for_matching_kernel():
+    inj = FaultInjector()
+    inj.load_plan({"rules": [{"kind": "kernel",
+                              "target": "rs_encode"}]})
+    inj.kernel("rs_decode")
+    with pytest.raises(InjectedFault):
+        inj.kernel("rs_encode")
+
+
+# ---------------------------------------------------------------------------
+# hook points end-to-end (the scenarios the subsystem exists to prove)
+
+
+def _engine(tmp_path, n=6, k=4, m=2):
+    from minio_tpu.erasure.engine import ErasureObjects
+    from minio_tpu.storage.xl import XLStorage
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(n)]
+    return ErasureObjects(disks, k, m, block_size=64 * 1024), disks
+
+
+def test_injected_corruption_is_caught_and_reconstructed(tmp_path):
+    """Corrupt rule on one drive's shard reads: bitrot verification
+    drops the rotten window and the GET reconstructs byte-exact."""
+    eng, disks = _engine(tmp_path)
+    eng.make_bucket("b")
+    body = os.urandom(300_000)
+    eng.put_object("b", "k", body)
+    FAULTS.load_plan({"rules": [
+        {"kind": "corrupt", "target": disks[0].root,
+         "op": "read_file"}]})
+    got, _ = eng.get_object("b", "k")
+    assert got == body
+
+
+def test_torn_write_detected_on_read_and_healed(tmp_path):
+    """Torn-write rule (half of every append persists) on one drive:
+    the PUT still commits at quorum, the torn shard fails frame
+    verification on GET, and a heal rewrites it."""
+    eng, disks = _engine(tmp_path)
+    eng.make_bucket("b")
+    body = os.urandom(300_000)
+    FAULTS.load_plan({"rules": [
+        {"kind": "torn_write", "target": disks[1].root,
+         "op": "append_file"}]})
+    eng.put_object("b", "k", body)
+    FAULTS.clear()
+    got, _ = eng.get_object("b", "k")
+    assert got == body
+    res = eng.healer.heal_object("b", "k")
+    assert res.after_ok == len(disks), res
+    got, _ = eng.get_object("b", "k")
+    assert got == body
+
+
+def test_partition_closes_peer_health_gate():
+    """Partition rule: the transport refuses the peer before any
+    socket I/O and marks it offline (reconnect probes take over)."""
+    from minio_tpu.rpc.transport import RPCClient
+    from minio_tpu.storage import errors as serr
+    cl = RPCClient("127.0.0.1", 1, b"key")
+    FAULTS.load_plan({"rules": [
+        {"kind": "partition", "target": "127.0.0.1:1"}]})
+    assert cl.is_online()
+    with pytest.raises(serr.DiskNotFound, match="injected partition"):
+        cl.call("storage", "disk_info", {})
+    assert not cl.is_online()
+
+
+def test_kernel_fault_falls_back_to_host_encode(tmp_path):
+    """Kernel-dispatch fault on rs_encode: the coalescer declines the
+    batch, callers host-encode, and the PUT/GET round-trip stays
+    byte-exact — failover, not failure."""
+    from minio_tpu.ops import batching
+    eng, disks = _engine(tmp_path)
+    eng.make_bucket("b")
+    FAULTS.load_plan({"rules": [{"kind": "kernel",
+                                 "target": "rs_encode"}]})
+    body = os.urandom(300_000)
+    eng.put_object("b", "k", body)
+    got, _ = eng.get_object("b", "k")
+    assert got == body
+
+
+def test_reads_fall_back_to_quarantined_drives_below_k(tmp_path):
+    """Availability over hygiene: with m+1 drives quarantined (healthy
+    survivors < k), the metadata fan-out's second pass probes the
+    quarantined drives after all and the GET serves byte-exact —
+    quarantine must degrade reads, never strand intact data."""
+    from minio_tpu.obs.drivemon import DRIVEMON
+    eng, disks = _engine(tmp_path)  # 4+2
+    try:
+        eng.make_bucket("b")
+        body = os.urandom(300_000)
+        eng.put_object("b", "k", body)
+        for ep in eng.endpoints[:3]:  # m+1: healthy = 3 < k = 4
+            DRIVEMON.quarantine(ep)
+        assert sum(DRIVEMON.is_quarantined(ep)
+                   for ep in eng.endpoints) == 3
+        got, _ = eng.get_object("b", "k")
+        assert got == body
+
+        # A definitive miss must NOT probe quarantined drives: the
+        # healthy disks' FileNotFound answers the 404 immediately —
+        # blocking a nonexistent-key lookup on a possibly-hung
+        # quarantined drive would be the exact stall the pre-fail
+        # exists to avoid.
+        probed = []
+        for i in range(3):
+            orig = disks[i].read_version
+            def spy(*a, _orig=orig, _i=i, **kw):
+                probed.append(_i)
+                return _orig(*a, **kw)
+            disks[i].read_version = spy
+        with pytest.raises(Exception):
+            eng.get_object("b", "does-not-exist")
+        assert probed == [], "quarantined drives probed on a 404"
+    finally:
+        eng.shutdown()
+        DRIVEMON.reset()
+
+
+def test_offline_probe_jitter_spreads_reconnects():
+    """The offline window is jittered per mark: many marks spread over
+    [OFFLINE_RETRY, (1+J) x OFFLINE_RETRY] instead of one instant."""
+    import time as _time
+    from minio_tpu.rpc.transport import RPCClient
+    cl = RPCClient("127.0.0.1", 1, b"key")
+    windows = set()
+    for _ in range(32):
+        cl._mark_offline()
+        windows.add(round(cl._offline_until - _time.monotonic(), 4))
+    lo, hi = min(windows), max(windows)
+    assert len(windows) > 1, "no jitter: identical windows"
+    assert lo >= cl.OFFLINE_RETRY * 0.99
+    assert hi <= cl.OFFLINE_RETRY * (1 + cl.OFFLINE_JITTER) * 1.01
+
+
+def test_config_kv_round_trip(tmp_path):
+    """fault_inject config subsystem: a compact-JSON plan loads at
+    apply time, a bad plan is rejected before persisting, and
+    `rpc offline_retry` reloads the transport's class knob live."""
+    import json
+    from minio_tpu.erasure.engine import ErasureObjects
+    from minio_tpu.rpc.transport import RPCClient
+    from minio_tpu.s3.server import S3Server
+    from minio_tpu.storage.xl import XLStorage
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    layer = ErasureObjects(disks, 2, 2, block_size=64 * 1024)
+    srv = S3Server(layer, "a", "s")
+    srv.start()
+    old_retry = RPCClient.OFFLINE_RETRY
+    try:
+        plan = json.dumps({"seed": 5, "rules": [
+            {"kind": "latency", "target": "/nope",
+             "latency_ms": 1}]}, separators=(",", ":"))
+        srv.config.set_kv(f"fault_inject enable=on plan={plan}")
+        assert FAULTS.enabled and FAULTS.snapshot()["seed"] == 5
+        with pytest.raises(ValueError):
+            srv.config.set_kv("fault_inject plan={not-json")
+        with pytest.raises(ValueError):
+            srv.config.set_kv("fault_inject enable=maybe")
+        srv.config.set_kv("fault_inject enable=off")
+        assert not FAULTS.enabled
+        srv.config.set_kv("rpc offline_retry=750ms")
+        assert RPCClient.OFFLINE_RETRY == pytest.approx(0.75)
+        with pytest.raises(ValueError):
+            srv.config.set_kv("rpc offline_retry=0s")
+    finally:
+        RPCClient.OFFLINE_RETRY = old_retry
+        srv.stop()

@@ -30,6 +30,7 @@ from tools.mtpu_lint.rules.locks import BlockingUnderLockRule
 from tools.mtpu_lint.rules.obs import (MetricNameRule, NativeAssertRule,
                                        QosMetricCallRule)
 from tools.mtpu_lint.rules.resources import ResourceLeakRule
+from tools.mtpu_lint.rules.retries import BoundedRetryRule
 
 from minio_tpu.utils import locktrace
 
@@ -288,6 +289,103 @@ def test_storage_api_error_runtime_mapping():
 
     assert s3err.storage_api_error(Flaky("x")) is s3err.ERR_SLOW_DOWN
     assert s3err.storage_api_error(ValueError("not storage")) is None
+
+
+# ---------------------------------------------------------------------------
+# R6 — retry loops bounded + backed off
+
+
+def test_r6_flags_unbounded_and_hot_while_retry():
+    src = (
+        "def call(op):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return op()\n"
+        "        except OSError:\n"
+        "            continue\n")
+    found = _check(BoundedRetryRule(), src)
+    msgs = " ".join(f.message for f in found)
+    assert len(found) == 2, found
+    assert "unbounded" in msgs and "backoff" in msgs
+
+
+def test_r6_flags_attempt_loop_without_backoff():
+    src = (
+        "def call(op):\n"
+        "    for attempt in range(4):\n"
+        "        try:\n"
+        "            return op()\n"
+        "        except OSError:\n"
+        "            pass\n")
+    found = _check(BoundedRetryRule(), src)
+    assert len(found) == 1 and "backoff" in found[0].message
+
+
+def test_r6_negative_bounded_backoff_and_iteration():
+    src = (
+        "import time\n"
+        "def call(op, items):\n"
+        "    for attempt in range(4):\n"
+        "        try:\n"
+        "            return op()\n"
+        "        except OSError:\n"
+        "            time.sleep(2 ** attempt)\n"
+        "    out = []\n"
+        "    for it in items:\n"
+        "        try:\n"
+        "            out.append(op(it))\n"
+        "        except OSError:\n"
+        "            continue\n"
+        "    while items:\n"
+        "        it = items.pop()\n"
+        "        try:\n"
+        "            op(it)\n"
+        "        except OSError:\n"
+        "            continue\n"
+        "    return out\n")
+    assert _check(BoundedRetryRule(), src) == []
+
+
+def test_r6_ignores_continue_owned_by_nested_loop():
+    src = (
+        "def call(op, xs):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return op()\n"
+        "        except OSError:\n"
+        "            for x in xs:\n"
+        "                if not x:\n"
+        "                    continue\n"
+        "                op(x)\n"
+        "            return None\n")
+    assert _check(BoundedRetryRule(), src) == []
+
+
+def test_r6_ignores_event_loop_with_per_item_try():
+    """`while True:` wrapping a for whose try/except continue-skips a
+    bad ITEM is an event loop — the continue re-runs the for, not the
+    while, so R6 must stay quiet (iteration, not retry)."""
+    src = (
+        "def serve(q):\n"
+        "    while True:\n"
+        "        for item in q.drain():\n"
+        "            try:\n"
+        "                handle(item)\n"
+        "            except OSError:\n"
+        "                continue\n")
+    assert _check(BoundedRetryRule(), src) == []
+
+
+def test_r6_scoped_to_package():
+    src = (
+        "def call(op):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return op()\n"
+        "        except OSError:\n"
+        "            continue\n")
+    rule = BoundedRetryRule()
+    assert not rule.applies(_ctx(src, "tools/sample.py"))
 
 
 # ---------------------------------------------------------------------------

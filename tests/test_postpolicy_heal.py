@@ -14,7 +14,8 @@ from minio_tpu.s3 import formupload as fu
 from minio_tpu.s3.client import S3Client
 from minio_tpu.s3.server import S3Server
 from minio_tpu.storage.xl import XLStorage
-from minio_tpu.utils.dyntimeout import LOG_SIZE, DynamicTimeout
+from minio_tpu.utils.dyntimeout import (LOG_SIZE, DynamicTimeout,
+                                        PercentileBudget)
 
 ACCESS, SECRET = "ppadmin", "ppadmin-secret"
 
@@ -203,6 +204,49 @@ def test_dynamic_timeout_stable_mixed():
     for _ in range(LOG_SIZE):
         dt.log_success(4.0)
     assert 7.0 <= dt.timeout <= 10.0
+
+
+def test_percentile_budget_cold_start_is_ceiling():
+    pb = PercentileBudget(multiplier=4.0, floor=0.05, ceiling=2.0)
+    assert pb.budget() == 2.0
+    for _ in range(PercentileBudget.MIN_SAMPLES - 1):
+        pb.observe(0.010)
+    # Still one sample short of warm: no hedging budget yet.
+    assert pb.budget() == 2.0
+    pb.observe(0.010)
+    assert pb.budget() < 2.0
+
+
+def test_percentile_budget_tracks_healthy_population():
+    pb = PercentileBudget(multiplier=4.0, floor=0.001, ceiling=10.0)
+    for _ in range(64):
+        pb.observe(0.010)
+    assert pb.budget() == pytest.approx(0.040, rel=0.01)
+    # Population-wide slowdown: the budget follows, compounding past
+    # the censoring cap within a few rings.
+    for _ in range(PercentileBudget.RING * 8):
+        pb.observe(0.100)
+    assert pb.budget() == pytest.approx(0.400, rel=0.05)
+
+
+def test_percentile_budget_straggler_minority_censored():
+    """A persistent 1-in-6 straggler at 100x must not ratchet the
+    budget toward the fault latency (observe() clamps at the current
+    budget and p75 sits inside the healthy mass)."""
+    pb = PercentileBudget(multiplier=4.0, floor=0.001, ceiling=10.0)
+    for i in range(PercentileBudget.RING * 4):
+        pb.observe(1.0 if i % 6 == 5 else 0.010)
+    assert pb.budget() < 0.100
+
+
+def test_percentile_budget_floor_ceiling_and_reset():
+    pb = PercentileBudget(multiplier=4.0, floor=0.05, ceiling=2.0)
+    for _ in range(64):
+        pb.observe(0.0001)
+    assert pb.budget() == 0.05
+    pb.reset()
+    # Reset returns to cold start: ceiling until MIN_SAMPLES again.
+    assert pb.budget() == 2.0
 
 
 def test_post_policy_uncovered_field_rejected(server, client):

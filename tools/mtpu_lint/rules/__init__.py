@@ -10,6 +10,7 @@ from .obs import (DrivemonSlowlogMetricCallRule, MetricNameRule,
                   NativeAssertRule, PipelineMetricCallRule,
                   QosMetricCallRule)
 from .resources import ResourceLeakRule
+from .retries import BoundedRetryRule
 
 
 def all_rules():
@@ -19,6 +20,7 @@ def all_rules():
         BlockingUnderLockRule(),
         KernelPurityRule(),
         ErrorMapRule(),
+        BoundedRetryRule(),
         NativeAssertRule(),
         MetricNameRule(),
         QosMetricCallRule(),

@@ -1,0 +1,125 @@
+"""R6 — retry loops must be bounded and must back off.
+
+The robustness PR's quarantine/probation and fault-injection work adds
+retry shapes all over the stack, and the two ways a retry loop goes
+wrong in production are always the same: it retries FOREVER (a dead
+peer turns one stuck request into a stuck thread pool), or it retries
+HOT (no sleep between attempts — the "retry storm" that turns a brief
+brownout into a self-sustained outage; the transport's offline-probe
+jitter exists for exactly this reason).
+
+What counts as a retry loop (deliberately narrow — a ``for item in
+items`` loop that ``continue``-skips a bad ITEM is iteration, not
+retry):
+
+  - a constant-true ``while`` loop (``while True:``) containing a
+    ``try`` whose except handler reaches a ``continue`` of THAT loop —
+    the loop re-runs the same work after a failure with nothing making
+    progress toward an exit (a condition-driven ``while work:`` drain
+    loop that continue-skips a failed item is iteration, and its own
+    test is the bound);
+  - a ``for <attempt-ish name> in range(...)`` loop containing a
+    ``try``/``except`` — the bounded-attempts idiom (bounded by
+    construction; only the backoff requirement applies).
+
+Violations:
+
+  - UNBOUNDED: a constant-true ``while`` retry loop (``while True:``)
+    — bound the attempts in the loop condition or switch to
+    ``for attempt in range(N)``. (A while-condition that can go false
+    is taken as the bound.)
+  - NO BACKOFF: no ``sleep``/``wait``/``throttle_background`` call
+    lexically inside the loop — hot-spinning retries amplify the very
+    failure they are retrying through.
+
+Deliberate one-shot retries (e.g. the transport's single fresh-socket
+retry after a stale pooled connection) carry justified suppressions —
+the waiver doubles as documentation of WHY the shape is safe.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Rule, terminal_name
+
+_BACKOFF_NAMES = {"sleep", "wait", "throttle_background", "backoff"}
+_ATTEMPT_VAR = re.compile(r"(attempt|tries|retry|retries|backoff)",
+                          re.IGNORECASE)
+
+
+def _is_const_true(test: ast.AST) -> bool:
+    return isinstance(test, ast.Constant) and bool(test.value)
+
+
+def _has_backoff(loop: ast.AST) -> bool:
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Call) and \
+                terminal_name(node.func) in _BACKOFF_NAMES:
+            return True
+    return False
+
+
+def _handler_continues(loop: ast.AST) -> bool:
+    """True when an except handler inside `loop` reaches a `continue`
+    OWNED BY `loop` itself. Ownership needs nesting awareness in both
+    directions: a continue inside a loop nested IN the handler belongs
+    to that nested loop, and a try nested in an inner for/while (the
+    `while True: for item: try/except: continue` event-loop shape)
+    retries the ITEM iteration, not this loop — so any intermediate
+    loop on the path cuts the claim."""
+    def scan(node: ast.AST, in_handler: bool) -> bool:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.While, ast.For, ast.AsyncFor,
+                                  ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue  # nested loop/scope owns its own continues
+            if in_handler and isinstance(child, ast.Continue):
+                return True
+            if scan(child, in_handler
+                    or isinstance(child, ast.ExceptHandler)):
+                return True
+        return False
+    return scan(loop, False)
+
+
+class BoundedRetryRule(Rule):
+    id = "R6"
+    title = ("retry loops must have a bounded attempt count and a "
+             "backoff between attempts")
+
+    def applies(self, ctx) -> bool:
+        return ctx.relpath.startswith("minio_tpu/")
+
+    def check(self, ctx):
+        self.ctx = ctx
+        self.findings = []
+        self.visit(ctx.tree)
+        return self.findings
+
+    def visit_While(self, node: ast.While) -> None:
+        if _is_const_true(node.test) and _handler_continues(node):
+            self.flag(node, (
+                "unbounded retry loop: the except-continue retries "
+                "forever — bound the attempts (a tries counter in "
+                "the while condition, or for attempt in range(N))"))
+            if not _has_backoff(node):
+                self.flag(node, (
+                    "retry loop without backoff: add a sleep/backoff "
+                    "between attempts so retries cannot hot-spin"))
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        is_attempts = (
+            isinstance(node.target, ast.Name)
+            and _ATTEMPT_VAR.search(node.target.id)
+            and isinstance(node.iter, ast.Call)
+            and terminal_name(node.iter.func) == "range")
+        if is_attempts and any(isinstance(n, ast.Try)
+                               for n in ast.walk(node)):
+            if not _has_backoff(node):
+                self.flag(node, (
+                    "retry loop without backoff: add a sleep/backoff "
+                    "between attempts so retries cannot hot-spin"))
+        self.generic_visit(node)
