@@ -86,6 +86,25 @@ def _retrying(fn, what: str, attempts: int = 4, base_sleep: float = 2.0):
     return None, last
 
 
+def _backend_mix(before: dict, after: dict) -> dict:
+    """Fractions of kernel-dispatched BYTES per kernprof backend over
+    a [before, after) mix_snapshot window (dispatch-count fractions
+    when no bytes moved). This is the stamp that keeps a host-mode
+    bench from masquerading as a device number."""
+    deltas = {}
+    for b, cur in after.items():
+        prev = before.get(b, {})
+        deltas[b] = {k: cur.get(k, 0) - prev.get(k, 0)
+                     for k in ("bytes", "dispatches")}
+    basis = "bytes" if any(d["bytes"] for d in deltas.values()) \
+        else "dispatches"
+    total = sum(d[basis] for d in deltas.values())
+    if total <= 0:
+        return {}
+    return {b: round(d[basis] / total, 4)
+            for b, d in sorted(deltas.items()) if d[basis]}
+
+
 def _pipelined_seconds_per_iter(launch, sync, n1: int = 4, n2: int = 20,
                                 ) -> float:
     def run(n: int) -> float:
@@ -826,6 +845,7 @@ def main() -> None:
     # a future regression that makes a config quietly slow (or drags
     # one disk) shows up in the BENCH record, not just in the value.
     from minio_tpu.obs.drivemon import DRIVEMON
+    from minio_tpu.obs.kernprof import KERNPROF
     from minio_tpu.obs.slowlog import SLOWLOG
     config_pipeline = {"put_p50": "put", "multipart": "put",
                        "get_2lost": "get", "heal": "heal"}
@@ -854,11 +874,14 @@ def main() -> None:
             DRIVEMON.reset()
             before = PIPE_STATS.snapshot()
             slow_before = SLOWLOG.total
+            mix_before = KERNPROF.mix_snapshot()
             out = fn()
             if pipe is not None:
                 factor_box["factor"] = PipelineStats.overlap_factor(
                     before, PIPE_STATS.snapshot(), pipe)
             factor_box["slowlog"] = SLOWLOG.total - slow_before
+            factor_box["mix"] = _backend_mix(mix_before,
+                                             KERNPROF.mix_snapshot())
             return out
 
         res, err = _retrying(run_measured, name, attempts=2,
@@ -868,6 +891,11 @@ def main() -> None:
             if factor_box.get("factor") is not None:
                 res["overlap_factor"] = round(factor_box["factor"], 3)
             res["slowlog_entries"] = factor_box.get("slowlog", 0)
+            # Which dispatch backend actually did this config's math
+            # (kernprof byte fractions): a host-mode run can never
+            # masquerade as a device number again — the exact r04/r05
+            # ambiguity the ROADMAP bench caveat flags.
+            res["backend_mix"] = factor_box.get("mix", {})
             suspect, faulty = DRIVEMON.counts()
             res["drive_suspect"] = suspect
             res["drive_faulty"] = faulty
@@ -920,6 +948,14 @@ def main() -> None:
     from minio_tpu.ops import batching
     out["configs"] = configs
     out["stats"] = batching.STATS.snapshot()
+    # Whole-run dispatch honesty stamp: byte fractions per kernprof
+    # backend plus the backend health states at exit. The device hunt
+    # measures in its own subprocess, so this records what THIS
+    # process's configs actually ran on.
+    out["backend_mix"] = _backend_mix({}, KERNPROF.mix_snapshot())
+    out["kernel_backends"] = {
+        b: info["state"]
+        for b, info in KERNPROF.snapshot()["backends"].items()}
     if errors:
         out["errors"] = errors
     print(json.dumps(out))

@@ -96,6 +96,11 @@ DEFAULT_KVS: dict[str, dict[str, str]] = {
         "slow_ms_list": "",
         "slow_ms_admin": "",
         "profile_on_slow": "off",
+        # Timeline sample ring (obs/timeline.py): one sample every
+        # `timeline_sample`, kept for `timeline_retention` at fixed
+        # memory (the ring is capacity-clamped; see MAX_SAMPLES).
+        "timeline_sample": "1s",
+        "timeline_retention": "15m",
     },
 }
 

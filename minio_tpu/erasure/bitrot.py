@@ -59,11 +59,12 @@ def digest_chunks(algo: str, data: bytes, chunk_size: int) -> list[bytes]:
         return []
     if algo in (HIGHWAYHASH256, HIGHWAYHASH256S):
         from ..obs.kernel_stats import HH256, KERNEL, timed
+        from ..obs.kernprof import NATIVE
         with timed() as t:
             native = hh256_chunks_native(data, chunk_size, MAGIC_KEY)
         if native is not None:
             KERNEL.record(HH256, False, len(data), t.s,
-                          blocks=len(native))
+                          blocks=len(native), backend=NATIVE)
             return native
     n = ceil_frac(len(data), chunk_size)
     return [digest(algo, data[i * chunk_size:(i + 1) * chunk_size])
@@ -115,7 +116,7 @@ def _hash_rows_device(stacked, total_bytes: int, n_requests: int):
         batching.HH_STATS.add(True, total_bytes, n_requests)
         return digs
     except Exception as exc:  # noqa: BLE001 - degrade loudly, don't fail IO
-        batching._warn_device_fallback(exc)
+        batching.device_dispatch_failed(exc)
         batching.HH_STATS.add(False, total_bytes, n_requests)
         return None
 
@@ -133,12 +134,14 @@ def digest_rows(algo: str, arr):
     if algo in (HIGHWAYHASH256, HIGHWAYHASH256S):
         from ..native import hh256_rows_native
         from ..obs.kernel_stats import HH256, KERNEL, timed
+        from ..obs.kernprof import NATIVE
         with timed() as t:
             out = hh256_rows_native(arr, MAGIC_KEY)
         if out is not None:
             from ..ops import batching
             batching.HH_STATS.add(False, arr.size)
-            KERNEL.record(HH256, False, arr.size, t.s, blocks=B)
+            KERNEL.record(HH256, False, arr.size, t.s, blocks=B,
+                          backend=NATIVE)
             return out
     out = np.empty((B, hash_size(algo)), dtype=np.uint8)
     for i in range(B):

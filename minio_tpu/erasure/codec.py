@@ -120,12 +120,14 @@ class Erasure:
         from ..obs.kernel_stats import KERNEL, RS_ENCODE, timed
         from ..ops.rs_matrix import parity_matrix
         with timed() as t:
-            shards[self.data_blocks:] = batching.host_apply(
+            parity, host_backend = batching.host_apply_tagged(
                 parity_matrix(self.data_blocks, self.parity_blocks),
                 shards[:self.data_blocks])
+            shards[self.data_blocks:] = parity
         batching.STATS.add(False, shards[:self.data_blocks].nbytes)
         KERNEL.record(RS_ENCODE, False,
-                      shards[:self.data_blocks].nbytes, t.s, blocks=1)
+                      shards[:self.data_blocks].nbytes, t.s, blocks=1,
+                      backend=host_backend)
         return shards
 
     def encode_blocks_batch(self, blocks: np.ndarray) -> np.ndarray:

@@ -90,7 +90,10 @@ def get_lib() -> ctypes.CDLL | None:
 def _disable_native(reason: str) -> None:
     """A native kernel returned inconsistent results: distrust the
     whole library for the rest of the process (every caller degrades
-    to its host/pure-Python path) and say so loudly once."""
+    to its host/pure-Python path) and say so loudly once.  The
+    kernprof backend state machine hears about it too, so the 'native'
+    lane shows DEGRADED/DOWN on the health surfaces and the recovery
+    probe (``probe()``) owns re-adoption."""
     global _LIB, _TRIED
     import logging
     with _LOCK:
@@ -98,6 +101,46 @@ def _disable_native(reason: str) -> None:
         _TRIED = True
     logging.getLogger("minio_tpu.native").warning(
         "native kernel disabled: %s", reason)
+    try:
+        from ..obs.kernprof import KERNPROF, NATIVE
+        KERNPROF.dispatch_failed(NATIVE, reason)
+    except Exception:
+        pass  # never let telemetry break the degrade path
+
+
+def probe() -> bool:
+    """Recovery probe for the kernprof 'native' backend: re-attempt
+    build+load (a ``_disable_native`` poisons the cached handle for
+    the process — this is the only path that un-poisons it) and run a
+    known-answer self-check through both exported kernel families.
+    True only when the library loads AND answers correctly."""
+    global _TRIED
+    with _LOCK:
+        if _LIB is None:
+            _TRIED = False  # allow get_lib() to rebuild/reload
+    if get_lib() is None:
+        return False
+    try:
+        import numpy as np
+
+        from ..ops.gf256 import gf_mat_vec_apply
+        from ..ops.hh256 import MAGIC_KEY, HighwayHash256
+        data = b"minio-tpu native probe"
+        want = HighwayHash256(MAGIC_KEY).update(data).digest()
+        if hh256_native(data, MAGIC_KEY) != want:
+            _disable_native("probe: hh256 known-answer mismatch")
+            return False
+        mat = np.array([[1, 2], [3, 4]], dtype=np.uint8)
+        cols = np.arange(2 * 64, dtype=np.uint8).reshape(2, 64)
+        got = rs_apply_native(mat, cols)
+        if got is None or not (got == gf_mat_vec_apply(mat,
+                                                       cols)).all():
+            _disable_native("probe: rs_gf_apply known-answer mismatch")
+            return False
+        return True
+    except Exception as exc:  # noqa: BLE001 - a probe must not raise
+        _disable_native(f"probe raised: {exc!r}")
+        return False
 
 
 def hh256_native(data: bytes, key: bytes) -> bytes | None:

@@ -1,9 +1,14 @@
 """Observability: span-based request tracing (span.py), the metrics-v2
-registry with node/cluster Prometheus endpoints (metrics2.py), and TPU
-kernel accounting (kernel_stats.py). See docs/observability.md."""
+registry with node/cluster Prometheus endpoints (metrics2.py), TPU
+kernel accounting (kernel_stats.py), per-dispatch kernel profiling +
+backend health (kernprof.py), and the cluster timeline sample ring
+(timeline.py). See docs/observability.md."""
 
 from .kernel_stats import KERNEL
+from .kernprof import KERNPROF
 from .metrics2 import METRICS2
 from .span import TRACER, current_span
+from .timeline import TIMELINE
 
-__all__ = ["KERNEL", "METRICS2", "TRACER", "current_span"]
+__all__ = ["KERNEL", "KERNPROF", "METRICS2", "TIMELINE", "TRACER",
+           "current_span"]

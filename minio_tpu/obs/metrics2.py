@@ -278,6 +278,31 @@ METRICS2.register(
     "minio_tpu_v2_kernel_coalesced_requests_total", "counter",
     "Requests merged into coalesced kernel dispatches.")
 METRICS2.register(
+    "minio_tpu_v2_kernel_dispatch_ms", "histogram",
+    "Per-dispatch kernel latency in milliseconds, by kernel, dispatch "
+    "backend (device/native/xla-cpu/host) and batch-size bucket.")
+METRICS2.register(
+    "minio_tpu_v2_kernel_queue_wait_ms", "histogram",
+    "Time a request's encode batch waited in the coalescer window "
+    "before dispatch, by kernel (the queue half of the queue-wait vs "
+    "execute split).")
+METRICS2.register(
+    "minio_tpu_v2_kernel_backend_bytes_total", "counter",
+    "Bytes dispatched per kernel and dispatch backend "
+    "(device/native/xla-cpu/host) — the timeline's GiB/s numerator.")
+METRICS2.register(
+    "minio_tpu_v2_kernel_backend_state", "gauge",
+    "Dispatch backend health state (0=up, 1=degraded, 2=down), "
+    "by backend.")
+METRICS2.register(
+    "minio_tpu_v2_kernel_backend_transitions_total", "counter",
+    "Dispatch backend health-state transitions, by backend and "
+    "new state.")
+METRICS2.register(
+    "minio_tpu_v2_kernel_backend_probes_total", "counter",
+    "Recovery probes of kernel dispatch backends, by backend and "
+    "result (pass/fail).")
+METRICS2.register(
     "minio_tpu_v2_traces_completed_total", "counter",
     "Completed request traces.")
 METRICS2.register(

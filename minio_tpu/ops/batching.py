@@ -41,20 +41,36 @@ import numpy as np
 from .gf256 import gf_mat_vec_apply
 from .rs_matrix import any_decode_matrix
 
-_warned_fallback = False
+def attempt_backend() -> str:
+    """Which kernprof backend a 'device' dispatch actually lands on:
+    a real accelerator when one is visible, else the XLA bit-plane
+    path jitted on the CPU platform (what a pinned backend="tpu" runs
+    when no device answers — the r04/r05 bench distinction)."""
+    from ..obs.kernprof import DEVICE, XLA_CPU
+    return DEVICE if device_present() else XLA_CPU
 
 
-def _warn_device_fallback(exc: BaseException) -> None:
-    """Loud, once-per-process warning when device math silently degrades
-    to host — the round-2 verdict's 'log loudly on fallback' rule."""
-    global _warned_fallback
-    if _warned_fallback:
-        return
-    _warned_fallback = True
-    import logging
-    logging.getLogger("minio_tpu.ops").warning(
-        "TPU dispatch failed; codec falling back to host for this and "
-        "further failures: %r", exc)
+def device_dispatch_failed(exc: BaseException) -> None:
+    """A device-lane dispatch raised: feed the per-backend health
+    state machine (obs/kernprof.py).  This replaces the old
+    once-per-process ``_warned_fallback`` warning — every backend
+    state TRANSITION logs with its cause, so a recovered relay that
+    fails again (or a second distinct failure mode) is never silent,
+    while a steadily-down backend doesn't spam."""
+    from ..obs.kernprof import KERNPROF
+    KERNPROF.dispatch_failed(attempt_backend(), exc)
+
+
+def _device_allowed(device_fallback: bool = True) -> bool:
+    """State-machine gate on the device lane: a DOWN backend is
+    skipped (recovery is the probe's job, real traffic stops paying
+    the failure latency).  A pinned backend (device_fallback=False)
+    bypasses the gate — the operator asked for errors, not silent
+    rerouting."""
+    if not device_fallback:
+        return True
+    from ..obs.kernprof import KERNPROF
+    return KERNPROF.allow(attempt_backend())
 
 
 class DispatchStats:
@@ -177,19 +193,28 @@ def _device_reconstruct(stack: np.ndarray, k: int, m: int,
     with timed() as t:
         out = np.asarray(rs_tpu.gf_apply(bm, device_put_batch(stack)))
     KERNEL.record(RS_DECODE, True, stack.nbytes, t.s,
-                  blocks=stack.shape[0])
+                  blocks=stack.shape[0], backend=attempt_backend())
     return out
+
+
+def host_apply_tagged(mat: np.ndarray, cols: np.ndarray,
+                      ) -> tuple[np.ndarray, str]:
+    """host_apply plus which backend actually ran (kernprof NATIVE
+    when the C++ kernel answered, HOST for the numpy table-gather) —
+    the per-dispatch profile must not lump them: they differ ~10x."""
+    from ..obs.kernprof import HOST, NATIVE
+    from ..native import rs_apply_native
+    out = rs_apply_native(mat, cols)
+    if out is None:
+        return gf_mat_vec_apply(mat, cols), HOST
+    return out, NATIVE
 
 
 def host_apply(mat: np.ndarray, cols: np.ndarray) -> np.ndarray:
     """(r, k) GF matrix x (k, N) bytes on the host: the C++ nibble-
     shuffle kernel (native/rs.cc) when built, numpy table-gather
     otherwise. Byte-identical either way (tests/test_rs_native.py)."""
-    from ..native import rs_apply_native
-    out = rs_apply_native(mat, cols)
-    if out is None:
-        out = gf_mat_vec_apply(mat, cols)
-    return out
+    return host_apply_tagged(mat, cols)[0]
 
 
 def _host_reconstruct(stack: np.ndarray, mat: np.ndarray) -> np.ndarray:
@@ -202,9 +227,10 @@ def _host_reconstruct(stack: np.ndarray, mat: np.ndarray) -> np.ndarray:
     B, n_used, S = stack.shape
     with timed() as t:
         cols = stack.transpose(1, 0, 2).reshape(n_used, B * S)
-        out = host_apply(mat, cols)
+        out, backend = host_apply_tagged(mat, cols)
         out = out.reshape(mat.shape[0], B, S).transpose(1, 0, 2)
-    KERNEL.record(RS_DECODE, False, stack.nbytes, t.s, blocks=B)
+    KERNEL.record(RS_DECODE, False, stack.nbytes, t.s, blocks=B,
+                  backend=backend)
     return out
 
 
@@ -256,7 +282,8 @@ def reconstruct_blocks(blocks: list[list[np.ndarray | None]], k: int,
             for bi in idxs for j in used]).reshape(
                 len(idxs), len(used), S)
         with qos_sched.GATE.dispatch(lane):
-            if use_device(stack.nbytes):
+            if use_device(stack.nbytes) and \
+                    _device_allowed(device_fallback):
                 try:
                     # Kernel-dispatch fault hook (minio_tpu/faultinject):
                     # an injected failure lands inside this try so the
@@ -269,7 +296,7 @@ def reconstruct_blocks(blocks: list[list[np.ndarray | None]], k: int,
                 except Exception as exc:
                     if not device_fallback:
                         raise
-                    _warn_device_fallback(exc)
+                    device_dispatch_failed(exc)
                     rebuilt = _host_reconstruct(stack, mat)
                     STATS.add(False, stack.nbytes, len(idxs))
             else:
@@ -297,10 +324,11 @@ def host_encode(blocks: np.ndarray, k: int, m: int) -> np.ndarray:
         out = np.zeros((B, k + m, S), dtype=np.uint8)
         out[:, :k] = blocks
         cols = blocks.transpose(1, 0, 2).reshape(k, B * S)
-        parity = host_apply(parity_matrix(k, m), cols)
+        parity, backend = host_apply_tagged(parity_matrix(k, m), cols)
         out[:, k:] = parity.reshape(m, B, S).transpose(1, 0, 2)
     STATS.add(False, blocks.nbytes)
-    KERNEL.record(RS_ENCODE, False, blocks.nbytes, t.s, blocks=B)
+    KERNEL.record(RS_ENCODE, False, blocks.nbytes, t.s, blocks=B,
+                  backend=backend)
     return out
 
 
@@ -318,11 +346,12 @@ def host_encode_shardmajor(blocks: np.ndarray, k: int,
     with timed() as t:
         out = np.empty((k + m, B, S), dtype=np.uint8)
         out[:k] = blocks.transpose(1, 0, 2)
-        parity = host_apply(parity_matrix(k, m),
-                            out[:k].reshape(k, B * S))
+        parity, backend = host_apply_tagged(parity_matrix(k, m),
+                                            out[:k].reshape(k, B * S))
         out[k:] = parity.reshape(m, B, S)
     STATS.add(False, blocks.nbytes)
-    KERNEL.record(RS_ENCODE, False, blocks.nbytes, t.s, blocks=B)
+    KERNEL.record(RS_ENCODE, False, blocks.nbytes, t.s, blocks=B,
+                  backend=backend)
     return out
 
 
@@ -334,6 +363,10 @@ class _EncodeRequest:
     done: threading.Event = field(default_factory=threading.Event)
     result: np.ndarray | None = None
     declined: bool = False
+    # Enqueue stamp: the coalescer window wait this request paid,
+    # reported separately from device-execute wall (obs/kernprof.py
+    # queue-wait vs execute split).
+    t_enq: float = field(default_factory=time.perf_counter)
 
 
 class EncodeCoalescer:
@@ -415,7 +448,9 @@ class EncodeCoalescer:
             # PUT with the full window latency (round-3 verdict weak #6).
             # A concurrent burst still coalesces: the queue is non-empty
             # when the next request is already waiting.
-            if self._q.empty() and not self._use_device(req.blocks.nbytes):
+            if self._q.empty() and not (
+                    self._use_device(req.blocks.nbytes)
+                    and _device_allowed()):
                 self._dispatch(batch)
                 continue
             deadline = time.monotonic() + self.window_s
@@ -434,13 +469,22 @@ class EncodeCoalescer:
             self._dispatch(batch)
 
     def _dispatch(self, batch: list[_EncodeRequest]) -> None:
+        from ..obs.kernprof import KERNPROF
+        from ..obs.kernel_stats import RS_ENCODE as _RS_ENC
+        now = time.perf_counter()
+        for r in batch:
+            # Window wait, whatever the outcome: a declined request
+            # still paid it on top of its own host encode.
+            KERNPROF.record_queue_wait(_RS_ENC,
+                                       (now - r.t_enq) * 1e3)
         groups: dict[tuple, list[_EncodeRequest]] = {}
         for r in batch:
             key = (r.k, r.m, r.blocks.shape[-1])
             groups.setdefault(key, []).append(r)
         for (k, m, S), reqs in groups.items():
             total = sum(r.blocks.nbytes for r in reqs)
-            if not self._use_device(total):
+            if not self._use_device(total) or \
+                    not KERNPROF.allow(attempt_backend()):
                 for r in reqs:
                     r.declined = True
                     r.done.set()
@@ -468,7 +512,7 @@ class EncodeCoalescer:
                     r.result = encoded[off:off + B]
                     off += B
             except BaseException as exc:
-                _warn_device_fallback(exc)
+                device_dispatch_failed(exc)
                 for r in reqs:
                     r.declined = True
             finally:
@@ -502,6 +546,15 @@ def device_present() -> bool:
         except Exception:
             _device_present = False
     return _device_present
+
+
+def reprobe_device_present() -> bool:
+    """Drop the cached device census and re-ask jax — the kernprof
+    DEVICE recovery probe's entry point, so a relay that bounced back
+    mid-process is re-adopted without a restart."""
+    global _device_present
+    _device_present = None
+    return device_present()
 
 
 def get_coalescer() -> EncodeCoalescer:

@@ -334,5 +334,6 @@ def hash_chunks(chunks: np.ndarray, key: bytes = MAGIC_KEY) -> np.ndarray:
     with timed() as t:
         out = np.asarray(_hash_chunks_device(words, rem_packet, init,
                                              n_full, rem))
-    KERNEL.record(HH256, True, chunks.nbytes, t.s, blocks=B)
+    KERNEL.record(HH256, True, chunks.nbytes, t.s, blocks=B,
+                  backend=batching.attempt_backend())
     return out.view(np.uint8).reshape(B, 32)

@@ -240,7 +240,8 @@ def encode_batch(data: np.ndarray, k: int, m: int) -> np.ndarray:
             placed = jnp.asarray(data)
         out = np.asarray(encode_blocks(bm, placed))
     KERNEL.record(RS_ENCODE, True, data.nbytes, t.s,
-                  blocks=data.shape[0] if data.ndim == 3 else 1)
+                  blocks=data.shape[0] if data.ndim == 3 else 1,
+                  backend=batching.attempt_backend())
     return out
 
 

@@ -130,6 +130,17 @@ class PeerRPCService:
         from ..obs.drivemon import DRIVEMON
         return ({"drivemon": DRIVEMON.snapshot()}, b"")
 
+    def rpc_timeline(self, args: dict, payload: bytes):
+        """This node's timeline sample ring for the cluster timeline
+        endpoint's bucket-aligned merge (obs/timeline.py
+        merge_timelines).  `n` bounds the tail so a peer scrape never
+        ships more history than the caller will merge."""
+        from ..obs.timeline import TIMELINE
+        n = None
+        if args.get("n") is not None:
+            n = max(1, min(int(args["n"]), 36000))
+        return ({"timeline": TIMELINE.snapshot(n=n)}, b"")
+
     def rpc_server_info(self, args: dict, payload: bytes):
         srv = self._server()
         return ({"version": __version__,
@@ -352,6 +363,14 @@ class NotificationSys:
         endpoint (unreachable peers degrade, never the scrape)."""
         return {k: (v if isinstance(v, dict) else {"error": str(v)})
                 for k, v in self._fanout("drivemon", {}).items()}
+
+    def timeline_all(self, n: int | None = None) -> dict:
+        """Per-peer timeline snapshots for the cluster timeline merge
+        (unreachable peers degrade to an error entry; their buckets
+        simply carry fewer nodes)."""
+        args: dict = {} if n is None else {"n": n}
+        return {k: (v if isinstance(v, dict) else {"error": str(v)})
+                for k, v in self._fanout("timeline", args).items()}
 
     def server_info_all(self) -> dict:
         return {k: (v if isinstance(v, dict) else {"error": str(v)})

@@ -641,6 +641,20 @@ class AdminHandlers:
             out["profile"] = SLOWLOG.last_profile
         return out
 
+    def h_kernel_health(self, p, body):
+        """Kernel dispatch health (obs/kernprof.py): per-backend state
+        machine (device/native/xla-cpu/host with fail streaks + last
+        failure cause) and cumulative dispatch/byte mix.  ``?probe=
+        true`` runs one recovery probe per backend first — the manual
+        'is the relay back yet?' lever (probes are tiny real
+        dispatches; root-only surface, so no amplification risk)."""
+        from ..obs.kernprof import KERNPROF
+        out: dict = {}
+        if p.get("probe") == "true":
+            out["probed"] = KERNPROF.probe_all()
+        out.update(KERNPROF.snapshot())
+        return out
+
     def h_drive_health(self, p, body):
         """Admin view of the drive-health monitor (same shape as the
         unauthenticated /minio-tpu/v2/health/drives node endpoint, but

@@ -2233,6 +2233,7 @@ class S3Server:
                     raise ValueError(f"audit endpoint {ep!r} must be "
                                      "http(s)")
         if subsys == "obs":
+            from ..qos.deadline import parse_duration
             for key, v in kvs.items():
                 if key.startswith("slow_ms"):
                     if v.strip() == "":
@@ -2249,6 +2250,14 @@ class S3Server:
                         raise ValueError(
                             f"obs profile_on_slow={v!r}: must be "
                             "on/off")
+                elif key in ("timeline_sample", "timeline_retention"):
+                    try:
+                        if parse_duration(v) <= 0:
+                            raise ValueError
+                    except ValueError:
+                        raise ValueError(
+                            f"obs {key}={v!r}: must be a positive "
+                            "duration like 1s / 500ms / 15m")
         if subsys == "rpc":
             from ..qos.deadline import parse_duration
             for key, v in kvs.items():
@@ -2394,6 +2403,20 @@ class S3Server:
             from ..logger import Logger
             Logger.get().log_once(
                 f"obs slowlog config invalid, keeping previous: {e}",
+                "config")
+        # Timeline ring shape reloads live (obs/timeline.py keeps the
+        # history it already has, up to the new capacity).
+        from ..obs.timeline import TIMELINE
+        try:
+            _period = parse_duration(cfg.get("obs", "timeline_sample"))
+            _keep = parse_duration(cfg.get("obs", "timeline_retention"))
+            if _period <= 0 or _keep <= 0:
+                raise ValueError("timeline durations must be positive")
+            TIMELINE.configure(_period, _keep)
+        except ValueError as e:  # env override may carry garbage
+            from ..logger import Logger
+            Logger.get().log_once(
+                f"obs timeline config invalid, keeping previous: {e}",
                 "config")
         ep = cfg.get("audit_webhook", "endpoint")
         tok = cfg.get("audit_webhook", "auth_token")
@@ -2864,6 +2887,25 @@ class S3Server:
             return 200, "application/json", _json.dumps(doc).encode()
         if raw_path == "/minio-tpu/v2/health/cluster/drives":
             return self._health_cluster_drives()
+        if raw_path == "/minio-tpu/v2/timeline":
+            # Node timeline: the in-process ring of 1-second samples
+            # (obs/timeline.py) — per-class rates, kernel GiB/s per
+            # backend, drive census, worst-sample trace exemplars.
+            # `?n=` tails, `?since=` returns samples after a stamp
+            # (what mtpu_top uses for incremental refresh).
+            from ..obs.timeline import TIMELINE
+            try:
+                n, since = self._parse_n_since(params)
+            except ValueError:
+                return 400, "text/plain", b"bad n/since"
+            doc = TIMELINE.snapshot(n=n, since=since)
+            return 200, "application/json", _json.dumps(doc).encode()
+        if raw_path == "/minio-tpu/v2/timeline/cluster":
+            try:
+                n, since = self._parse_n_since(params)
+            except ValueError:
+                return 400, "text/plain", b"bad n/since"
+            return self._timeline_cluster(n=n, since=since)
         if raw_path in ("/minio-tpu/console", "/minio-tpu/console/") \
                 and method == "GET":
             from .console import console_response
@@ -3029,6 +3071,50 @@ class S3Server:
 
         body = self._cached_cluster_scrape("_cluster_drives_cache",
                                            build)
+        return 200, "application/json", body
+
+    @staticmethod
+    def _parse_n_since(params: dict) -> tuple[int | None, float | None]:
+        """The timeline endpoints' shared ?n=/?since= parse (raises
+        ValueError on garbage; both routes answer 400)."""
+        n = int(params["n"]) if "n" in params else None
+        since = float(params["since"]) if "since" in params else None
+        return n, since
+
+    _cluster_timeline_cache: tuple[float, bytes] | None = None
+
+    def _timeline_cluster(self, n: int | None = None,
+                          since: float | None = None,
+                          ) -> tuple[int, str, bytes]:
+        """Cluster timeline: this node's sample ring merged with every
+        peer's (scraped over the `timeline` peer RPC) on aligned
+        1-second buckets — exactly the metrics2/drivemon fan-in shape,
+        TTL-cached against scrape amplification. A lagging peer's
+        samples still land in their own time buckets (merge_timelines
+        keeps per-bucket node counts honest).  The cache holds the
+        FULL merge (one shape for every caller); ?n=/?since= slice it
+        per request so a 1 Hz mtpu_top poll doesn't re-download the
+        whole 15-minute history each refresh."""
+        import json as _json
+        from ..obs import timeline as tl
+
+        def build() -> bytes:
+            snaps = [tl.TIMELINE.snapshot()]
+            if self.notification is not None:
+                for res in self.notification.timeline_all().values():
+                    snap = res.get("timeline") if isinstance(res, dict) \
+                        else None
+                    if isinstance(snap, dict):
+                        snaps.append(snap)
+            return _json.dumps(tl.merge_timelines(snaps)).encode()
+
+        body = self._cached_cluster_scrape("_cluster_timeline_cache",
+                                           build)
+        if n is not None or since is not None:
+            doc = _json.loads(body)
+            doc["samples"] = tl.slice_samples(doc.get("samples", []),
+                                              n=n, since=since)
+            body = _json.dumps(doc).encode()
         return 200, "application/json", body
 
     def _mrf_stats(self) -> dict:
@@ -3427,6 +3513,13 @@ class S3Server:
                         # ring, annotated with the blamed layer
                         # (obs/slowlog.py). Sheds/burnt deadlines are
                         # exempt (deliberate backpressure).
+                        # Worst-request exemplar for the current
+                        # timeline window: a spike in the 1s series
+                        # links straight to this request's trace tree
+                        # (and its slowlog entry when captured).
+                        from ..obs.timeline import TIMELINE
+                        TIMELINE.note_request(req.qos_class, dur_ms,
+                                              req.request_id)
                         from ..obs.slowlog import SLOWLOG
                         slow_entry = SLOWLOG.record(
                             api=api, api_class=req.qos_class,
@@ -3594,6 +3687,13 @@ class S3Server:
 
         Handler.timeout = 120  # idle keep-alive reaper
         self._httpd = _Server((host, port), Handler)
+        # Timeline sampler: one process-wide daemon deltaing the
+        # registry per sample period (refcounted — the last server to
+        # stop stops it; its tick also drives kernprof's rate-limited
+        # backend recovery probes).
+        from ..obs.timeline import TIMELINE
+        TIMELINE.start()
+        self._timeline_started = True
         if cert_manager is not None:
             cert_manager.start()
         # mtpu-lint: disable=R1 -- the accept loop itself; request context is OPENED per request below it
@@ -3611,6 +3711,10 @@ class S3Server:
         return self.handlers.kms if self.handlers else None
 
     def stop(self) -> None:
+        if getattr(self, "_timeline_started", False):
+            self._timeline_started = False
+            from ..obs.timeline import TIMELINE
+            TIMELINE.stop()
         if getattr(self, "cert_manager", None) is not None:
             self.cert_manager.stop()
         if self._httpd:

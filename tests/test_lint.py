@@ -27,7 +27,8 @@ from tools.mtpu_lint.rules.concurrency import ThreadCtxRule
 from tools.mtpu_lint.rules.errormap import ErrorMapRule
 from tools.mtpu_lint.rules.kernels import KernelPurityRule
 from tools.mtpu_lint.rules.locks import BlockingUnderLockRule
-from tools.mtpu_lint.rules.obs import (MetricNameRule, NativeAssertRule,
+from tools.mtpu_lint.rules.obs import (KernprofTimelineMetricCallRule,
+                                       MetricNameRule, NativeAssertRule,
                                        QosMetricCallRule)
 from tools.mtpu_lint.rules.resources import ResourceLeakRule
 from tools.mtpu_lint.rules.retries import BoundedRetryRule
@@ -422,6 +423,31 @@ def test_o3_literal_recording_calls():
                       "minio_tpu/qos/sample.py")) == 2
     assert _check(QosMetricCallRule(), good,
                   "minio_tpu/qos/sample.py") == []
+
+
+def test_o6_kernprof_timeline_literal_recording_calls():
+    # POSITIVE: dynamic name + unregistered literal, in both scoped
+    # files of the kernprof/timeline family.
+    bad = ("def f(name):\n"
+           "    METRICS2.inc(name)\n"
+           "    METRICS2.set_gauge('minio_tpu_v2_not_a_real_series',"
+           " {'backend': 'device'}, 1)\n")
+    for path in ("minio_tpu/obs/kernprof.py",
+                 "minio_tpu/obs/timeline.py"):
+        assert len(_check(KernprofTimelineMetricCallRule(), bad,
+                          path)) == 2
+    # NEGATIVE: literal registered names are clean.
+    good = ("def f():\n"
+            "    METRICS2.set_gauge("
+            "'minio_tpu_v2_kernel_backend_state',"
+            " {'backend': 'device'}, 2)\n"
+            "    METRICS2.observe('minio_tpu_v2_kernel_dispatch_ms',"
+            " {'kernel': 'rs_encode'}, 1.5)\n")
+    assert _check(KernprofTimelineMetricCallRule(), good,
+                  "minio_tpu/obs/kernprof.py") == []
+    # Out of scope: the rule does not apply elsewhere in obs/.
+    assert not KernprofTimelineMetricCallRule().applies(
+        _ctx(bad, "minio_tpu/obs/metrics2.py"))
 
 
 # ---------------------------------------------------------------------------

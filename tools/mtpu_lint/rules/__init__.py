@@ -6,7 +6,8 @@ from .concurrency import ThreadCtxRule
 from .errormap import ErrorMapRule
 from .kernels import KernelPurityRule
 from .locks import BlockingUnderLockRule
-from .obs import (DrivemonSlowlogMetricCallRule, MetricNameRule,
+from .obs import (DrivemonSlowlogMetricCallRule,
+                  KernprofTimelineMetricCallRule, MetricNameRule,
                   NativeAssertRule, PipelineMetricCallRule,
                   QosMetricCallRule)
 from .resources import ResourceLeakRule
@@ -26,4 +27,5 @@ def all_rules():
         QosMetricCallRule(),
         PipelineMetricCallRule(),
         DrivemonSlowlogMetricCallRule(),
+        KernprofTimelineMetricCallRule(),
     ]

@@ -9,6 +9,7 @@ O2  every ``minio_tpu_v2_*`` string literal names a registered metric
 O3  qos/ recording calls pass literal registered names
 O4  utils/pipeline.py recording calls pass literal registered names
 O5  obs/drivemon.py + obs/slowlog.py recording calls likewise
+O6  obs/kernprof.py + obs/timeline.py recording calls likewise
 """
 
 from __future__ import annotations
@@ -128,3 +129,10 @@ class DrivemonSlowlogMetricCallRule(_LiteralCallRule):
     title = "drivemon/slowlog metric recordings use literal registered names"
     what = "drivemon/slowlog"
     paths = ("minio_tpu/obs/drivemon.py", "minio_tpu/obs/slowlog.py")
+
+
+class KernprofTimelineMetricCallRule(_LiteralCallRule):
+    id = "O6"
+    title = "kernprof/timeline metric recordings use literal registered names"
+    what = "kernprof/timeline"
+    paths = ("minio_tpu/obs/kernprof.py", "minio_tpu/obs/timeline.py")

@@ -1,0 +1,179 @@
+"""mtpu-top: live console view over the minio-tpu timeline endpoint.
+
+The `mc admin top` analog for this stack, dependency-free (stdlib
+urllib + ANSI only): per-class request rates / inflight / shed, kernel
+dispatch backend states + per-backend GiB/s, drive and quarantine
+census, MRF depth, hedge fires, and unicode sparkline history — all
+read from ``/minio-tpu/v2/timeline`` (node) or
+``/minio-tpu/v2/timeline/cluster`` (``--cluster``), which the server
+samples in-process (obs/timeline.py), so no scraper setup is needed.
+
+``--once`` prints a single snapshot and exits 0 — no TTY, no clearing
+— which is how tier-1 exercises this tool against a live test server
+so the console view can't rot (tests/test_timeline.py).
+
+Usage:
+    python -m tools.mtpu_top --url http://127.0.0.1:9000 [--cluster]
+    python -m tools.mtpu_top --url http://127.0.0.1:9000 --once
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+SPARK = "▁▂▃▄▅▆▇█"
+_CLASSES = ("read", "write", "list", "admin")
+_STATE_NAMES = {0: "UP", 1: "DEGRADED", 2: "DOWN"}
+
+
+def fetch_timeline(base_url: str, cluster: bool = False,
+                   n: int | None = None,
+                   timeout: float = 5.0) -> dict:
+    path = "/minio-tpu/v2/timeline" + ("/cluster" if cluster else "")
+    url = base_url.rstrip("/") + path
+    if n is not None:
+        url += f"?n={int(n)}"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def sparkline(values: list[float], width: int) -> str:
+    vals = values[-width:]
+    if not vals:
+        return ""
+    top = max(vals)
+    if top <= 0:
+        return SPARK[0] * len(vals)
+    return "".join(
+        SPARK[min(len(SPARK) - 1, int(v / top * (len(SPARK) - 1)))]
+        for v in vals)
+
+
+def _num(v: float) -> str:
+    if v >= 100:
+        return str(int(round(v)))
+    if v == int(v):
+        return str(int(v))
+    return f"{v:.1f}"
+
+
+def render(doc: dict, width: int = 60) -> str:
+    """One snapshot frame as plain text (no cursor control — the loop
+    adds clearing; --once prints this verbatim)."""
+    samples = doc.get("samples", [])
+    period = doc.get("periodS", 1.0) or 1.0
+
+    def dt(s: dict) -> float:
+        # Samples are deltas over the REAL inter-tick interval (the
+        # sampler drifts under load — exactly when someone is watching
+        # top); cluster-merged buckets carry no dt and normalize by
+        # the merge period.
+        return s.get("dt") or period
+
+    last: dict = samples[-1] if samples else {}
+    lines: list[str] = []
+    stamp = time.strftime("%Y-%m-%d %H:%M:%S")
+    nodes = last.get("nodes", doc.get("nodes", 1))
+    lines.append(f"minio-tpu top  {stamp}  "
+                 f"{len(samples)} samples @{_num(period)}s  "
+                 f"nodes={nodes}")
+
+    states = last.get("backendState", {})
+    gibs = last.get("kernelGiBs", {})
+    parts = []
+    for b in ("device", "native", "xla-cpu", "host"):
+        if b in states or b in gibs:
+            st = _STATE_NAMES.get(states.get(b, 0), "?")
+            rate = gibs.get(b, 0.0)
+            parts.append(f"{b} {st}"
+                         + (f" {rate:.3f} GiB/s" if rate else ""))
+    lines.append("kernel: " + (" | ".join(parts) or "no dispatches"))
+
+    lines.append(f"{'class':<7}{'qps':>8}{'inflight':>10}{'shed/s':>8}")
+    for c in _CLASSES:
+        qps = (last.get("qps", {}).get(c, 0)) / dt(last)
+        lines.append(f"{c:<7}{_num(qps):>8}"
+                     f"{_num(last.get('inflight', {}).get(c, 0)):>10}"
+                     f"{_num(last.get('shed', {}).get(c, 0) / dt(last)):>8}")
+    rx = last.get("rx", 0) / dt(last) / (1 << 20)
+    tx = last.get("tx", 0) / dt(last) / (1 << 20)
+    lines.append(f"rx {rx:.2f} MiB/s   tx {tx:.2f} MiB/s   "
+                 f"admission queue {_num(last.get('queueDepth', 0))}")
+    d = last.get("drives", {})
+    lines.append(f"drives: suspect={d.get('suspect', 0)} "
+                 f"faulty={d.get('faulty', 0)} "
+                 f"quarantined={d.get('quarantined', 0)}   "
+                 f"mrf depth={_num(last.get('mrfDepth', 0))}   "
+                 f"hedges/s={_num(last.get('hedgeFired', 0) / dt(last))}")
+
+    qps_hist = [sum((s.get("qps") or {}).values()) / dt(s)
+                for s in samples]
+    kern_hist = [sum((s.get("kernelBytes") or {}).values()) / dt(s)
+                 / (1 << 30) for s in samples]
+    lines.append(f"qps  {sparkline(qps_hist, width)}")
+    lines.append(f"gibs {sparkline(kern_hist, width)}")
+    worst = last.get("worstRequest")
+    if worst:
+        lines.append(f"worst: {worst.get('class', '?')} "
+                     f"{worst.get('durationMs', 0):.1f}ms "
+                     f"trace={worst.get('traceId', '')}"
+                     "  (admin /slowlog has the span tree)")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mtpu_top",
+        description="live console view over /minio-tpu/v2/timeline")
+    ap.add_argument("--url", default="http://127.0.0.1:9000",
+                    help="server base URL")
+    ap.add_argument("--cluster", action="store_true",
+                    help="read the cluster-merged timeline")
+    ap.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit (no TTY needed)")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="refresh seconds in live mode")
+    ap.add_argument("--n", type=int, default=120,
+                    help="history samples to fetch per refresh")
+    ap.add_argument("--width", type=int, default=60,
+                    help="sparkline width")
+    ap.add_argument("--timeout", type=float, default=5.0,
+                    help="HTTP timeout seconds")
+    args = ap.parse_args(argv)
+
+    def frame() -> str:
+        doc = fetch_timeline(args.url, cluster=args.cluster, n=args.n,
+                             timeout=args.timeout)
+        return render(doc, width=args.width)
+
+    if args.once:
+        try:
+            print(frame())
+        except (urllib.error.URLError, OSError, ValueError) as exc:
+            print(f"mtpu_top: cannot read timeline at {args.url}: "
+                  f"{exc}", file=sys.stderr)
+            return 1
+        return 0
+
+    try:
+        while True:
+            try:
+                body = frame()
+            except (urllib.error.URLError, OSError, ValueError) as exc:
+                body = (f"mtpu_top: cannot read timeline at "
+                        f"{args.url}: {exc}")
+            # Clear + home, then the frame: simple full-repaint at 1Hz.
+            sys.stdout.write("\x1b[2J\x1b[H" + body + "\n")
+            sys.stdout.flush()
+            time.sleep(max(args.interval, 0.1))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
