@@ -24,6 +24,10 @@ encode+decode GiB/s/chip (8+4, 1MiB blocks) — plus:
                                   rate + admitted p50/p99, and fg PUT
                                   p50 with/without a concurrent heal
                                   sweep (priority-lane interference)
+     7. hot_get                   Zipfian GETs, hot-object cache on vs
+                                  off (paired off/on/off): GET QPS
+                                  speedup, hit ratio, coalesced fills,
+                                  p99, cache-off consult overhead
   "stats":    batching.STATS snapshot (device-vs-host honesty counters)
   "errors":   per-config error strings (configs that failed still leave
               the others reported; the script never exits nonzero)
@@ -721,6 +725,133 @@ def bench_qos_brownout(np, workdir: str) -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_hot_get(np, workdir: str) -> dict:
+    """Hot-object serving tier: Zipfian GETs with the cache on vs off,
+    PAIRED off/on/off so VM drift brackets the measurement (PR 4's
+    method). Reports GET QPS both ways, the speedup, hit ratio,
+    coalesced-fill count, and p99 — stamped with the cache config the
+    way every config is stamped with backend_mix. Also records the
+    cache-OFF PUT+GET p50 as a cross-round tripwire: the consult hook
+    when disabled is one attribute read, so this number regressing
+    against earlier BENCH_r0N records means the default-off path grew
+    real cost (the code-present vs code-absent A/B cannot be toggled
+    at runtime — the round history IS the baseline)."""
+    import statistics as stats
+
+    from minio_tpu.cache.hotcache import HOTCACHE
+    from minio_tpu.erasure.engine import ErasureObjects
+    from minio_tpu.obs.metrics2 import METRICS2
+    from minio_tpu.s3.client import S3Client
+    from minio_tpu.s3.server import S3Server
+    from minio_tpu.storage.xl import XLStorage
+    from tools.loadgen import run_load
+
+    access, secret = "benchadmin", "benchadmin-secret"
+    base = workdir
+    if os.path.isdir("/dev/shm"):
+        # tmpfs like put_p50: this config tracks the serving path's
+        # CPU cost, not VM writeback noise.
+        base = tempfile.mkdtemp(prefix="minio-tpu-hotget-",
+                                dir="/dev/shm")
+    root = os.path.join(base, "cfg7")
+    # 4+2 like put_p50: wider sets convoy this 2-core box's quorum
+    # pool into multi-second tails that drown the signal.
+    disks = [XLStorage(os.path.join(root, f"disk{i}"))
+             for i in range(6)]
+    layer = ErasureObjects(disks, 4, 2, block_size=1024 * 1024)
+    srv = S3Server(layer, access, secret)
+    port = srv.start()
+    # revalidate must outlast warm+segment: a mem hit that trips the
+    # revalidation window pays a metadata fan-out, which is the miss
+    # path's dominant cost — the window is the operator's staleness
+    # bound, and the bench measures steady-state hits inside it.
+    keys, obj_bytes, zipf_s, seg_s = 64, 256 * 1024, 1.2, 4.0
+    cache_kv = ("cache enable=on mem_bytes=268435456 min_hits=1 "
+                "max_object_bytes=8388608 revalidate=30s")
+    try:
+        client = S3Client("127.0.0.1", port, access, secret)
+        client.make_bucket("bench")
+        rng = np.random.default_rng(7)
+        body = rng.integers(0, 256, obj_bytes).astype(np.uint8).tobytes()
+        for r in range(keys):   # preload the Zipf key space + warm
+            client.put_object("bench", f"hot/z{r}", body)
+
+        def seg(tag: str) -> dict:
+            return run_load("127.0.0.1", port, access, secret, "bench",
+                            concurrency=4, duration=seg_s,
+                            put_fraction=0.0, object_bytes=obj_bytes,
+                            key_prefix="hot", key_space=keys,
+                            zipf_s=zipf_s, seed=7)
+
+        off1 = seg("off1")
+
+        def m(name, labels=None):
+            return METRICS2.get(name, labels)
+
+        srv.config.set_kv(cache_kv)
+        for r in range(keys):
+            # Warm the tier: the measured window is STEADY-STATE hot
+            # serving (cold-fill cost is the miss path, measured by
+            # the off segments and amortized over an object's life).
+            client.get_object("bench", f"hot/z{r}")
+        hits0 = (m("minio_tpu_v2_cache_hits_total", {"tier": "mem"})
+                 + m("minio_tpu_v2_cache_hits_total", {"tier": "disk"}))
+        miss0 = m("minio_tpu_v2_cache_misses_total")
+        coal0 = m("minio_tpu_v2_cache_coalesced_waits_total")
+        on = seg("on")
+        hits = (m("minio_tpu_v2_cache_hits_total", {"tier": "mem"})
+                + m("minio_tpu_v2_cache_hits_total", {"tier": "disk"})
+                - hits0)
+        misses = m("minio_tpu_v2_cache_misses_total") - miss0
+        coalesced = m("minio_tpu_v2_cache_coalesced_waits_total") - coal0
+        srv.config.set_kv("cache enable=off")
+        off2 = seg("off2")
+
+        # Cache-OFF PUT+GET p50 tripwire (see docstring): the default
+        # mode's absolute cost, judged against prior rounds' records.
+        lat_pg: list[float] = []
+        for i in range(30):
+            t0 = time.perf_counter()
+            client.put_object("bench", f"ov-{i}", body)
+            client.get_object("bench", f"ov-{i}")
+            lat_pg.append(time.perf_counter() - t0)
+
+        qps_off = (off1["qps_achieved"] + off2["qps_achieved"]) / 2
+        qps_on = on["qps_achieved"]
+        lookups = hits + misses
+        return {
+            "metric": "hot_get",
+            "value": round(qps_on / max(qps_off, 1e-9), 2),
+            "unit": "x_get_qps",
+            "get_qps_cache_on": qps_on,
+            "get_qps_cache_off": round(qps_off, 2),
+            "p99_ms_cache_on": on["latency_ms"]["p99"],
+            "p99_ms_cache_off": round(
+                (off1["latency_ms"]["p99"]
+                 + off2["latency_ms"]["p99"]) / 2, 3),
+            "hit_ratio": round(hits / lookups, 4) if lookups else 0.0,
+            "cache_hits": hits, "cache_misses": misses,
+            "coalesced_fills": coalesced,
+            "key_distribution": on.get("key_distribution", {}),
+            "cache_off_put_get_p50_ms": round(
+                stats.median(lat_pg) * 1e3, 3),
+            "errors_other": (off1["errors_other"] + on["errors_other"]
+                             + off2["errors_other"]),
+            # The stamp: which cache config produced these numbers
+            # (like backend_mix stamps which backend ran the math).
+            "cache": {"keys": keys, "object_bytes": obj_bytes,
+                      "zipf_s": zipf_s, "segment_s": seg_s,
+                      "kv": cache_kv,
+                      "workdir": "tmpfs" if base != workdir else "disk"},
+        }
+    finally:
+        HOTCACHE.reset()
+        srv.stop()
+        shutil.rmtree(root, ignore_errors=True)
+        if base != workdir:
+            shutil.rmtree(base, ignore_errors=True)
+
+
 class _DeviceHunt(threading.Thread):
     """Background device acquisition for the WHOLE bench run.
 
@@ -860,7 +991,9 @@ def main() -> None:
                      ("degraded_tail",
                       lambda: bench_degraded_tail(np, workdir)),
                      ("qos_brownout",
-                      lambda: bench_qos_brownout(np, workdir))):
+                      lambda: bench_qos_brownout(np, workdir)),
+                     ("hot_get",
+                      lambda: bench_hot_get(np, workdir))):
         _progress(f"config {name} (host mode)")
         pipe = config_pipeline.get(name)
         factor_box: dict = {}

@@ -274,11 +274,18 @@ def _make_iam(layer, access: str, secret: str):
 
 
 def _maybe_wrap_cache(layer):
-    """Optional SSD edge cache in front of any backend topology (ref
-    newServerCacheObjects gate, cmd/server-main.go:517)."""
-    from .cache import CacheConfig, CacheObjectLayer
-    cfg = CacheConfig.from_env()
-    return layer if cfg is None else CacheObjectLayer(layer, cfg)
+    """The env-configured CacheObjectLayer wrapper is gone: caching is
+    now the hot-object serving tier INSIDE the erasure data plane
+    (cache/hotcache.py), configured via config-KV — e.g.
+    `mc admin config set cache enable=on dirs=/mnt/d1/cache`. Warn
+    anyone still setting the old env so the migration is visible."""
+    if os.environ.get("MINIO_CACHE_DRIVES"):
+        print("warning: MINIO_CACHE_DRIVES is no longer honored — "
+              "the disk-cache wrapper was replaced by the hot-object "
+              "serving tier; configure it with "
+              "`mc admin config set cache enable=on "
+              "dirs=<dir1,dir2,...>` instead", file=sys.stderr)
+    return layer
 
 
 def _serve(args) -> int:
@@ -328,6 +335,11 @@ def _serve(args) -> int:
                 node.notification.load_bucket_metadata
             server.bucket_meta.notify_delete = \
                 node.notification.delete_bucket_metadata
+            # Hot-object cache coherence: every local overwrite/delete
+            # pushes an invalidation (with its epoch stamp) to every
+            # peer's cache (rpc/peer.py cache_invalidate).
+            from .cache.hotcache import HOTCACHE
+            HOTCACHE.peer_notify = node.notification.cache_invalidate
         else:
             layer = _maybe_wrap_cache(
                 build_object_layer(args.disks, args.block_size))

@@ -1,3 +1,7 @@
-"""SSD edge-cache ObjectLayer wrapper (ref cmd/disk-cache.go)."""
+"""Hot-object serving tier (two-level decoded-object cache with
+single-flight fill; see hotcache.py). The former ``CacheObjectLayer``
+env-configured gateway wrapper was replaced by this tier in the
+erasure data plane — configure it via config-KV (``cache`` subsystem),
+not ``MINIO_CACHE_DRIVES``."""
 
-from .diskcache import CacheConfig, CacheObjectLayer  # noqa: F401
+from .hotcache import HOTCACHE, HotObjectCache  # noqa: F401

@@ -85,6 +85,24 @@ DEFAULT_KVS: dict[str, dict[str, str]] = {
         "enable": "off",
         "plan": "",
     },
+    # Hot-object serving tier (cache/hotcache.py): a two-level
+    # (memory + disk) decoded-object cache in the erasure GET path
+    # with single-flight fill and cross-peer invalidation. `dirs` is a
+    # comma-separated list of disk-tier directories (ideally one per
+    # data drive — placement is drive-health-aware); empty = memory
+    # tier only. `revalidate` bounds worst-case staleness after a LOST
+    # peer invalidation ("0" = revalidate every memory hit, "off" =
+    # trust invalidation alone). Replaces the removed
+    # MINIO_CACHE_DRIVES CacheObjectLayer wrapper.
+    "cache": {
+        "enable": "off",
+        "mem_bytes": "134217728",
+        "disk_bytes": "1073741824",
+        "dirs": "",
+        "min_hits": "1",
+        "max_object_bytes": "33554432",
+        "revalidate": "1s",
+    },
     # Slow-request capture SLOs (obs/slowlog.py): any request past its
     # class threshold (ms) lands in the slowlog ring with per-layer
     # blame. Per-class keys override the default; empty = inherit;

@@ -211,6 +211,13 @@ class ErasureObjects:
         from ..scanner.tracker import DataUpdateTracker
         self.update_tracker = DataUpdateTracker()
         self.metacache = MetacacheManager(self)
+        # Hot-object serving tier namespace (cache/hotcache.py): GETs
+        # consult the process-wide HOTCACHE under this engine-unique
+        # prefix, so two unrelated engines in one process (test
+        # fixtures, multi-pool layouts) can never serve each other's
+        # bytes; invalidation addresses (bucket, key) and clears every
+        # namespace.
+        self.cache_ns = uuid.uuid4().hex[:16]
 
     def shutdown(self) -> None:
         """Stop this engine's background daemons — the MRF heal queue
@@ -298,6 +305,8 @@ class ErasureObjects:
             undo_removals()
             raise
         self.metacache.drop_bucket(bucket)
+        from ..cache.hotcache import HOTCACHE
+        HOTCACHE.invalidate_bucket(bucket)
         self._mark_update(bucket)
 
     def list_buckets(self) -> list[dict]:
@@ -609,6 +618,10 @@ class ErasureObjects:
             cleanup_tmp(dead)
             self.mrf.add(bucket, object_name)
         self._mark_update(bucket, object_name)
+        # Write-through invalidation: drop every cached decoded copy
+        # of the old version, locally and (async) on every peer.
+        from ..cache.hotcache import HOTCACHE
+        HOTCACHE.invalidate(bucket, object_name)
         return ObjectInfo(bucket=bucket, name=object_name, size=total,
                           etag=etag, mod_time=mod_time,
                           version_id=version_id, metadata=meta,
@@ -930,8 +943,29 @@ class ErasureObjects:
                   for i in range(len(fis))]
         return fi, agreed
 
+    def _uncached_info(self, bucket: str, object_name: str,
+                       ) -> ObjectInfo:
+        """Metadata-quorum ObjectInfo bypassing the hot-object cache —
+        the cache's ETag-revalidation oracle (calling the public stat
+        would recurse straight back into the cache)."""
+        with self.ns_lock.read_locked(bucket, object_name):
+            fi, _ = self._quorum_file_info(bucket, object_name)
+        if fi.deleted:
+            raise ObjectNotFound(f"{bucket}/{object_name}")
+        return ObjectInfo.from_file_info(fi)
+
     def get_object_info(self, bucket: str, object_name: str,
                         version_id: str = "") -> ObjectInfo:
+        from ..cache.hotcache import HOTCACHE
+        if HOTCACHE.enabled and not version_id:
+            # Memory-tier stat: a hot GET's HEAD/stat half also skips
+            # the metadata fan-out (latest-only; versioned stats take
+            # the quorum path below).
+            info = HOTCACHE.lookup_info(
+                self.cache_ns, bucket, object_name,
+                lambda: self._uncached_info(bucket, object_name))
+            if info is not None:
+                return info
         self._check_bucket(bucket)
         # Same read lock as the data path: a stat racing a concurrent
         # commit/delete must see before-or-after state, never the
@@ -963,7 +997,21 @@ class ErasureObjects:
         cmd/erasure-decode.go:248-263). The read lock is held for the
         stream's lifetime, like the reference holds its read lock across
         the response write (cmd/erasure-object.go:134); exhaust or
-        close() the iterator to release it."""
+        close() the iterator to release it.
+
+        The hot-object cache is consulted twice (cache/hotcache.py):
+        a tier hit up front serves decoded bytes with NO disk I/O at
+        all; past the metadata quorum read, a concurrent fill of the
+        same key+etag is joined (coalesced wait — N cold GETs of one
+        hot key perform exactly one shard fan-out + decode), and a
+        full-object read registers itself as the single-flight fill."""
+        from ..cache.hotcache import HOTCACHE
+        if HOTCACHE.enabled and not version_id:
+            served = HOTCACHE.serve(
+                self.cache_ns, bucket, object_name, offset, length,
+                lambda: self._uncached_info(bucket, object_name))
+            if served is not None:
+                return served
         self._check_bucket(bucket)
         # The read lock covers metadata + data so a concurrent overwrite
         # cannot swap the data dir between the two reads.
@@ -986,11 +1034,66 @@ class ErasureObjects:
             if length == 0 or fi.size == 0:
                 ctx.__exit__(None, None, None)
                 return info, iter(())
+            if HOTCACHE.enabled and not version_id:
+                cached = self._cache_fill_or_join(
+                    ctx, fi, agreed, info, bucket, object_name,
+                    offset, length)
+                if cached is not None:
+                    return cached
             gen = self._iter_ranges(fi, agreed, offset, length)
             return info, _LockedStream(ctx, gen)
         except BaseException:
             ctx.__exit__(None, None, None)
             raise
+
+    def _cache_fill_or_join(self, ctx, fi, agreed, info, bucket: str,
+                            object_name: str, offset: int, length: int):
+        """Single-flight integration past the metadata read: join an
+        in-flight fill of this key+etag (releasing our read lock — the
+        filler's lock covers the data), or register as the fill when
+        this is a cacheable full-object read. Returns (info, stream)
+        or None to proceed with a plain erasure read."""
+        from ..cache.hotcache import HOTCACHE
+
+        def resume(pos: int, _off=offset, _len=length):
+            # Waiter fallback when the fill dies under it: re-read the
+            # remainder ourselves — but never stitch bytes of a
+            # DIFFERENT object version onto what the waiter already
+            # streamed.
+            info2, stream = self.get_object_stream(
+                bucket, object_name, offset=_off + pos,
+                length=_len - pos)
+            if info2.etag != fi.metadata.get("etag", ""):
+                try:
+                    stream.close()
+                except Exception:
+                    pass
+                raise QuorumError(
+                    f"{bucket}/{object_name} changed while a coalesced "
+                    "read was streaming from a failed fill", [])
+            return stream
+
+        waiter = HOTCACHE.join_fill(
+            self.cache_ns, bucket, object_name,
+            fi.metadata.get("etag", ""), offset, length, resume)
+        if waiter is not None:
+            ctx.__exit__(None, None, None)
+            return info, waiter
+        if offset != 0 or length != fi.size:
+            return None
+        fill = HOTCACHE.begin_fill(self.cache_ns, bucket, object_name,
+                                   info)
+        if fill is None:
+            return None
+        handed = False
+        try:
+            rdr = fill.reader(
+                self._iter_ranges(fi, agreed, 0, fi.size))
+            handed = True
+            return info, _LockedStream(ctx, rdr)
+        finally:
+            if not handed:
+                fill.abort(RuntimeError("fill setup failed"))
 
     def _quarantine_skip(self, alive: list, disk_errs: list,
                          wq: int) -> list[int]:
@@ -1450,6 +1553,8 @@ class ErasureObjects:
                 reduce_quorum_errs(errs, write_quorum(self.k, self.m),
                                    "delete_object(marker)")
             self._mark_update(bucket, object_name)
+            from ..cache.hotcache import HOTCACHE
+            HOTCACHE.invalidate(bucket, object_name)
             return ObjectInfo(bucket=bucket, name=object_name,
                               version_id=marker.version_id,
                               delete_marker=True,
@@ -1487,6 +1592,8 @@ class ErasureObjects:
              for e in eff],
             write_quorum(self.k, self.m), "delete_object")
         self._mark_update(bucket, object_name)
+        from ..cache.hotcache import HOTCACHE
+        HOTCACHE.invalidate(bucket, object_name)
         return ObjectInfo(bucket=bucket, name=object_name,
                           version_id=version_id,
                           delete_marker=was_marker)
@@ -1546,6 +1653,10 @@ class ErasureObjects:
             reduce_quorum_errs(errs, write_quorum(self.k, self.m),
                                "update_object_metadata")
         self._mark_update(bucket, object_name)
+        # Metadata (tags, replication status) lives in the cached
+        # ObjectInfo too: drop the entry.
+        from ..cache.hotcache import HOTCACHE
+        HOTCACHE.invalidate(bucket, object_name)
 
     def walk_object_names(self, bucket: str) -> list[str]:
         """Union-merge directory walk across disks: every object name

@@ -406,6 +406,10 @@ class MultipartUploads:
             eng.mrf.add(bucket, object_name)
         self._cleanup(bucket, object_name, upload_id)
         eng._mark_update(bucket, object_name)
+        # Multipart complete is an overwrite of the key: invalidate
+        # the hot-object cache (local + peer fan-out).
+        from ..cache.hotcache import HOTCACHE
+        HOTCACHE.invalidate(bucket, object_name)
 
         from .engine import ObjectInfo
         return ObjectInfo(bucket=bucket, name=object_name,

@@ -436,6 +436,13 @@ class DriveMonitor:
             d = self._drives.get(endpoint)
             return d.state if d is not None else OK
 
+    def endpoints(self) -> list[str]:
+        """Every registered drive endpoint (the hot-object cache maps
+        its disk-tier dirs onto these by path prefix for
+        health-informed placement)."""
+        with self._mu:
+            return list(self._drives)
+
     def counts(self) -> tuple[int, int]:
         """(suspect, faulty) drive counts."""
         with self._mu:

@@ -382,6 +382,38 @@ METRICS2.register(
     "Faults injected by the runtime fault-injection subsystem, "
     "by kind.")
 METRICS2.register(
+    "minio_tpu_v2_cache_hits_total", "counter",
+    "Hot-object cache hits, by tier (mem/disk).")
+METRICS2.register(
+    "minio_tpu_v2_cache_misses_total", "counter",
+    "Hot-object cache lookups that missed both tiers.")
+METRICS2.register(
+    "minio_tpu_v2_cache_fills_total", "counter",
+    "Single-flight cache fills settled, by result (cached/uncached/"
+    "invalidated/short/error/abandoned/waiter_fallback).")
+METRICS2.register(
+    "minio_tpu_v2_cache_coalesced_waits_total", "counter",
+    "GETs that coalesced onto another request's in-flight fill "
+    "instead of paying their own erasure read.")
+METRICS2.register(
+    "minio_tpu_v2_cache_evictions_total", "counter",
+    "Hot-object cache evictions, by tier and reason "
+    "(capacity/invalidate).")
+METRICS2.register(
+    "minio_tpu_v2_cache_stale_total", "counter",
+    "Cache hits rejected by ETag revalidation (a lost invalidation "
+    "caught before serving stale bytes), by tier.")
+METRICS2.register(
+    "minio_tpu_v2_cache_invalidations_total", "counter",
+    "Cache invalidation events that dropped entries or poisoned "
+    "in-flight fills, by source (local/peer/stale/bucket).")
+METRICS2.register(
+    "minio_tpu_v2_cache_bytes", "gauge",
+    "Bytes resident in the hot-object cache, by tier.")
+METRICS2.register(
+    "minio_tpu_v2_cache_entries", "gauge",
+    "Objects resident in the hot-object cache, by tier.")
+METRICS2.register(
     "minio_tpu_v2_slow_requests_total", "counter",
     "Requests captured by the slow-request log, by API class and "
     "blamed layer.")

@@ -204,6 +204,12 @@ class Timeline:
                 m("minio_tpu_v2_kernel_backend_bytes_total"),
                 by="backend"),
             "hedgeFired": hedge.get("fired", 0),
+            "cacheHits": _series_sum(m("minio_tpu_v2_cache_hits_total")),
+            "cacheMisses": _series_sum(
+                m("minio_tpu_v2_cache_misses_total")),
+            "cacheFills": _series_sum(
+                m("minio_tpu_v2_cache_fills_total")),
+            "cacheBytes": _series_sum(m("minio_tpu_v2_cache_bytes")),
             "mrfDepth": _series_sum(m("minio_tpu_v2_mrf_queue_depth")),
             "drives": {"suspect": suspect, "faulty": faulty,
                        "quarantined":
@@ -257,6 +263,15 @@ class Timeline:
                     for b, v in raw["kernelBytes"].items()},
                 "hedgeFired": self._delta(raw["hedgeFired"],
                                           prev["hedgeFired"]),
+                # Cache row (hot-object serving tier): hit/miss/fill
+                # deltas + resident bytes, rendered by mtpu_top.
+                "cacheHits": self._delta(raw.get("cacheHits", 0),
+                                         prev.get("cacheHits", 0)),
+                "cacheMisses": self._delta(raw.get("cacheMisses", 0),
+                                           prev.get("cacheMisses", 0)),
+                "cacheFills": self._delta(raw.get("cacheFills", 0),
+                                          prev.get("cacheFills", 0)),
+                "cacheBytes": raw.get("cacheBytes", 0),
                 "mrfDepth": raw["mrfDepth"],
                 "drives": dict(raw["drives"]),
                 "backendState": dict(raw["backendState"]),
@@ -343,6 +358,8 @@ def _collapse_node(snap: dict, period_s: float) -> list[dict]:
             "inflight": dict(last.get("inflight") or {}),
             "queueDepth": last.get("queueDepth", 0),
             "rx": 0, "tx": 0, "hedgeFired": 0,
+            "cacheHits": 0, "cacheMisses": 0, "cacheFills": 0,
+            "cacheBytes": last.get("cacheBytes", 0),
             "mrfDepth": last.get("mrfDepth", 0),
             "drives": dict(last.get("drives") or {}),
             "backendState": {},
@@ -351,7 +368,8 @@ def _collapse_node(snap: dict, period_s: float) -> list[dict]:
             for fld in ("qps", "shed", "kernelBytes"):
                 for k, v in (s.get(fld) or {}).items():
                     c[fld][k] = c[fld].get(k, 0) + v
-            for fld in ("rx", "tx", "hedgeFired"):
+            for fld in ("rx", "tx", "hedgeFired", "cacheHits",
+                        "cacheMisses", "cacheFills"):
                 c[fld] += s.get(fld, 0)
             for k, v in (s.get("backendState") or {}).items():
                 c["backendState"][k] = max(c["backendState"].get(k, 0),
@@ -396,6 +414,8 @@ def merge_timelines(snapshots: list[dict],
                     "queueDepth": 0, "rx": 0, "tx": 0,
                     "kernelBytes": {}, "kernelGiBs": {},
                     "hedgeFired": 0, "mrfDepth": 0,
+                    "cacheHits": 0, "cacheMisses": 0,
+                    "cacheFills": 0, "cacheBytes": 0,
                     "drives": {"suspect": 0, "faulty": 0,
                                "quarantined": 0},
                     "backendState": {},
@@ -406,7 +426,8 @@ def merge_timelines(snapshots: list[dict],
                 for k, v in (s.get(fld) or {}).items():
                     cur[fld][k] = cur[fld].get(k, 0) + v
             for fld in ("queueDepth", "rx", "tx", "hedgeFired",
-                        "mrfDepth"):
+                        "mrfDepth", "cacheHits", "cacheMisses",
+                        "cacheFills", "cacheBytes"):
                 cur[fld] += s.get(fld, 0)
             for k, v in (s.get("drives") or {}).items():
                 cur["drives"][k] = cur["drives"].get(k, 0) + v

@@ -82,6 +82,21 @@ class PeerRPCService:
 
     def rpc_delete_bucket_metadata(self, args: dict, payload: bytes):
         self._server().bucket_meta.invalidate(args["bucket"])
+        # A deleted bucket's hot-object entries must die with it.
+        from ..cache.hotcache import HOTCACHE
+        HOTCACHE.invalidate_bucket(args["bucket"])
+        return ({"ok": True}, b"")
+
+    def rpc_cache_invalidate(self, args: dict, payload: bytes):
+        """Hot-object cache invalidation push (cache/hotcache.py): a
+        peer overwrote/deleted bucket/key — drop our cached decoded
+        copies and poison in-flight fills. The epoch is the writer's
+        per-key version stamp (max-merged on our side); applied
+        WITHOUT re-propagation, so invalidations can't storm. Needs no
+        server binding — the cache is process-wide."""
+        from ..cache.hotcache import HOTCACHE
+        HOTCACHE.apply_peer_invalidation(args["bucket"], args["key"],
+                                         int(args.get("epoch", 0)))
         return ({"ok": True}, b"")
 
     # -- cluster-wide admin fan-in -------------------------------------
@@ -322,6 +337,15 @@ class NotificationSys:
 
     def delete_bucket_metadata(self, bucket: str) -> None:
         self._fanout_async("delete_bucket_metadata", {"bucket": bucket})
+
+    def cache_invalidate(self, bucket: str, key: str,
+                         epoch: int) -> None:
+        """Fire-and-forget hot-object cache invalidation: a lost push
+        degrades the peer to its ETag-revalidation backstop
+        (cache/hotcache.py), never the writer's request."""
+        self._fanout_async("cache_invalidate",
+                           {"bucket": bucket, "key": key,
+                            "epoch": int(epoch)})
 
     # -- synchronous fan-ins (admin aggregation) -----------------------
 

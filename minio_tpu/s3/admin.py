@@ -536,13 +536,13 @@ class AdminHandlers:
             raise ValueError(str(e))
         return {"ok": True}
 
-    # -- disk cache ----------------------------------------------------
+    # -- hot-object cache ----------------------------------------------
 
     def h_cache_stats(self, p, body):
-        layer = self.server.layer
-        if not hasattr(layer, "cache_stats"):
-            return {"enabled": False}
-        return {"enabled": True, **layer.cache_stats()}
+        """Hot-object serving tier stats (cache/hotcache.py): tier
+        occupancy, hit ratio, fill/invalidation counters."""
+        from ..cache.hotcache import HOTCACHE
+        return HOTCACHE.snapshot()
 
     # -- config KV (ref admin config APIs, cmd/admin-handlers-config-kv.go)
 

@@ -2258,6 +2258,33 @@ class S3Server:
                         raise ValueError(
                             f"obs {key}={v!r}: must be a positive "
                             "duration like 1s / 500ms / 15m")
+        if subsys == "cache":
+            from ..qos.deadline import parse_duration
+            for key, v in kvs.items():
+                if key == "enable":
+                    if v not in ("on", "off"):
+                        raise ValueError(
+                            f"cache enable={v!r}: must be on/off")
+                elif key in ("mem_bytes", "disk_bytes", "min_hits",
+                             "max_object_bytes"):
+                    try:
+                        if int(v) < 0:
+                            raise ValueError
+                    except ValueError:
+                        raise ValueError(
+                            f"cache {key}={v!r}: must be an integer "
+                            ">= 0")
+                elif key == "revalidate":
+                    if v == "off":
+                        continue
+                    try:
+                        if parse_duration(v) < 0:
+                            raise ValueError
+                    except ValueError:
+                        raise ValueError(
+                            f"cache revalidate={v!r}: must be a "
+                            "duration like 1s / 500ms, 0 (always), "
+                            "or off (never)")
         if subsys == "rpc":
             from ..qos.deadline import parse_duration
             for key, v in kvs.items():
@@ -2378,6 +2405,32 @@ class S3Server:
                 Logger.get().log_once(
                     f"fault_inject config invalid, ignored: {e}",
                     "config")
+        # Hot-object serving tier reloads live (cache/hotcache.py):
+        # budgets shrink in place, disabling clears both tiers, a dir
+        # change re-creates the disk tier.
+        from ..cache.hotcache import HOTCACHE
+        from ..qos.deadline import parse_duration as _pdur
+        try:
+            _reval_raw = cfg.get("cache", "revalidate").strip()
+            _reval = (None if _reval_raw == "off"
+                      else _pdur(_reval_raw))
+            if _reval is not None and _reval < 0:
+                raise ValueError("revalidate must be >= 0")
+            HOTCACHE.configure(
+                enable=cfg.get("cache", "enable") == "on",
+                mem_bytes=int(cfg.get("cache", "mem_bytes")),
+                disk_bytes=int(cfg.get("cache", "disk_bytes")),
+                dirs=[d for d in
+                      cfg.get("cache", "dirs").split(",") if d],
+                min_hits=int(cfg.get("cache", "min_hits")),
+                max_object_bytes=int(
+                    cfg.get("cache", "max_object_bytes")),
+                revalidate_s=_reval)
+        except ValueError as e:  # env override may carry garbage
+            from ..logger import Logger
+            Logger.get().log_once(
+                f"cache config invalid, keeping previous: {e}",
+                "config")
         # Slowlog SLO thresholds reload live (the always-on tail
         # capture must be tunable under fire, like the QoS caps).
         from ..obs.slowlog import SLOWLOG

@@ -1,154 +1,164 @@
-"""Disk cache wrapper: hit/miss, ETag validation, offline fallback,
-invalidation, watermark GC (ref cmd/disk-cache.go,
-cmd/disk-cache-backend.go)."""
+"""Disk tier of the hot-object serving cache (cache/hotcache.py).
 
-import json
-import shutil
+The former ``CacheObjectLayer`` gateway wrapper — whose get_object
+sliced the FULL cached body in memory even for tiny ranges — is gone;
+these tests pin the replacement disk tier's contract: ranges are
+served by seeking inside the cache file (never materializing the
+entry), capacity eviction is LRU under the byte quota, placement
+hashes across healthy dirs, and the old env-only configuration path
+is dead (config-KV is the only way in)."""
+
+import os
 
 import pytest
 
-from minio_tpu.cache import CacheConfig, CacheObjectLayer
+from minio_tpu.cache.hotcache import (DISK_READ_CHUNK, HOTCACHE,
+                                      _DiskStream)
 from minio_tpu.erasure.engine import ErasureObjects
-from minio_tpu.s3.client import S3Client
-from minio_tpu.s3.server import S3Server
 from minio_tpu.storage.xl import XLStorage
 
-ACCESS, SECRET = "cacheadm", "cacheadm-secret"
+BLOCK = 64 * 1024
 
 
-@pytest.fixture
-def stack(tmp_path):
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    HOTCACHE.reset()
+    yield
+    HOTCACHE.configure(enable=False, mem_bytes=128 << 20,
+                       disk_bytes=1 << 30, dirs=[], min_hits=1,
+                       max_object_bytes=32 << 20, revalidate_s=1.0)
+    HOTCACHE.reset()
+
+
+def _engine(tmp_path):
     disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)]
-    backend = ErasureObjects(disks, block_size=64 * 1024)
-    cache = CacheObjectLayer(backend, CacheConfig(
-        drives=[str(tmp_path / "cache0"), str(tmp_path / "cache1")]))
-    return backend, cache, tmp_path
+    eng = ErasureObjects(disks, block_size=BLOCK)
+    eng.hedge_enabled = False
+    return eng
 
 
-def test_cache_hit_after_first_read(stack):
-    backend, cache, _ = stack
-    cache.make_bucket("cb")
-    cache.put_object("cb", "hot.bin", b"H" * 10_000)
-    d = cache._drive("cb", "hot.bin")
-    assert (d.hits, d.misses) == (0, 0)
-    data, _ = cache.get_object("cb", "hot.bin")
-    assert data == b"H" * 10_000
-    assert (d.hits, d.misses) == (0, 1)
-    data, _ = cache.get_object("cb", "hot.bin")
-    assert data == b"H" * 10_000
-    assert (d.hits, d.misses) == (1, 1)
-    # Ranges come from the cached copy.
-    data, _ = cache.get_object("cb", "hot.bin", offset=100, length=50)
-    assert data == b"H" * 50
-    assert d.hits == 2
+def _fill_to_disk(eng, bucket, key, body, cdir):
+    """PUT + GET a body larger than the memory budget so it lands in
+    the disk tier."""
+    eng.put_object(bucket, key, body)
+    assert eng.get_object(bucket, key)[0] == body
+    snap = HOTCACHE.snapshot()
+    assert snap["diskEntries"] >= 1, snap
 
 
-def test_overwrite_invalidates(stack):
-    backend, cache, _ = stack
-    cache.make_bucket("inv")
-    cache.put_object("inv", "k", b"old")
-    cache.get_object("inv", "k")  # populate
-    cache.put_object("inv", "k", b"new-content")
-    data, _ = cache.get_object("inv", "k")
-    assert data == b"new-content"
+def test_range_read_seeks_instead_of_materializing(tmp_path):
+    """The satellite fix: a tiny range of a large cached object must
+    be served by seeking in the cache file — bounded reads, never the
+    whole entry in memory."""
+    eng = _engine(tmp_path)
+    cdir = tmp_path / "cache0"
+    big = DISK_READ_CHUNK * 4
+    HOTCACHE.configure(enable=True, mem_bytes=BLOCK,
+                       disk_bytes=1 << 30, dirs=[str(cdir)],
+                       min_hits=1, max_object_bytes=big * 2,
+                       revalidate_s=3600.0)
+    eng.make_bucket("b")
+    body = bytes(range(256)) * (big // 256)
+    _fill_to_disk(eng, "b", "big", body, cdir)
+
+    info, stream = eng.get_object_stream("b", "big", offset=big // 2,
+                                         length=1000)
+    assert isinstance(stream, _DiskStream)
+    chunks = list(stream)
+    assert b"".join(chunks) == body[big // 2:big // 2 + 1000]
+    # Bounded window reads: nothing close to the full entry.
+    assert all(len(c) <= DISK_READ_CHUNK for c in chunks)
+    # A full read comes back in bounded windows too.
+    info, stream = eng.get_object_stream("b", "big")
+    chunks = list(stream)
+    assert b"".join(chunks) == body
+    assert max(len(c) for c in chunks) <= DISK_READ_CHUNK
 
 
-def test_stale_etag_revalidates(stack):
-    """A write that bypassed the cache wrapper (other node) is caught
-    by the ETag check."""
-    backend, cache, _ = stack
-    cache.make_bucket("stale")
-    cache.put_object("stale", "k", b"v1")
-    cache.get_object("stale", "k")
-    backend.put_object("stale", "k", b"v2-direct")  # behind our back
-    data, info = cache.get_object("stale", "k")
-    assert data == b"v2-direct"
+def test_disk_quota_evicts_lru(tmp_path):
+    eng = _engine(tmp_path)
+    cdir = tmp_path / "cache0"
+    size = BLOCK * 2
+    HOTCACHE.configure(enable=True, mem_bytes=BLOCK // 2,
+                       disk_bytes=size * 3 + 100, dirs=[str(cdir)],
+                       min_hits=1, max_object_bytes=size * 2,
+                       revalidate_s=3600.0)
+    eng.make_bucket("b")
+    for i in range(5):   # each fill demotes straight to disk
+        body = bytes([i]) * size
+        eng.put_object("b", f"o{i}", body)
+        assert eng.get_object("b", f"o{i}")[0] == body
+    snap = HOTCACHE.snapshot()
+    assert snap["diskEntries"] <= 3
+    assert snap["diskBytesUsed"] <= size * 3 + 100
+    # The NEWEST entries survived (LRU eviction order).
+    from minio_tpu.obs.metrics2 import METRICS2
+    assert METRICS2.get("minio_tpu_v2_cache_evictions_total",
+                        {"tier": "disk", "reason": "capacity"}) >= 2
+    # Evicted files are actually unlinked from the dir.
+    files = [f for f in (cdir / "mtpu-cache").rglob("*")
+             if f.is_file() and not f.name.endswith(".meta")]
+    assert len(files) == snap["diskEntries"]
 
 
-def test_backend_offline_serves_cached(stack):
-    backend, cache, tmp_path = stack
-    cache.make_bucket("edge")
-    payload = b"survive the WAN" * 100
-    cache.put_object("edge", "doc", payload)
-    cache.get_object("edge", "doc")  # populate
-    # Backend loses quorum (transport failure, NOT a semantic 404).
-    from minio_tpu.parallel.quorum import QuorumError
-
-    def down(*a, **kw):
-        raise QuorumError("backend offline", [])
-
-    backend.get_object_info = down
-    backend.get_object = down
-    data, info = cache.get_object("edge", "doc")
-    assert data == payload
-    assert info.etag
-    # HEAD path (get_object_info) survives too — the S3 handler stats
-    # before reading.
-    assert cache.get_object_info("edge", "doc").etag == info.etag
-    # A deleted object must NOT be edge-served: semantic 404 wins.
-    from minio_tpu.erasure.engine import ObjectNotFound
-
-    def gone(*a, **kw):
-        raise ObjectNotFound("edge/doc")
-
-    backend.get_object_info = gone
-    with pytest.raises(ObjectNotFound):
-        cache.get_object("edge", "doc")
+def test_placement_hashes_across_dirs(tmp_path):
+    eng = _engine(tmp_path)
+    dirs = [tmp_path / "c0", tmp_path / "c1", tmp_path / "c2"]
+    size = BLOCK * 2
+    HOTCACHE.configure(enable=True, mem_bytes=BLOCK // 2,
+                       disk_bytes=1 << 30,
+                       dirs=[str(d) for d in dirs], min_hits=1,
+                       max_object_bytes=size * 2, revalidate_s=3600.0)
+    eng.make_bucket("b")
+    for i in range(12):
+        body = bytes([i]) * size
+        eng.put_object("b", f"k{i}", body)
+        assert eng.get_object("b", f"k{i}")[0] == body
+    used = [d for d in dirs
+            if any(f.is_file() for f in (d / "mtpu-cache").rglob("*"))]
+    assert len(used) >= 2, "12 keys must spread over multiple dirs"
+    # Every entry carries its sidecar meta (operator debuggability).
+    for d in used:
+        data_files = [f for f in (d / "mtpu-cache").rglob("*")
+                      if f.is_file() and not f.name.endswith(".meta")]
+        for f in data_files:
+            assert os.path.exists(f"{f}.meta")
 
 
-def test_delete_invalidates(stack):
-    backend, cache, _ = stack
-    cache.make_bucket("del")
-    cache.put_object("del", "k", b"x")
-    cache.get_object("del", "k")
-    cache.delete_object("del", "k")
-    d = cache._drive("del", "k")
-    assert d.get("del", "k") is None
+def test_reconfigure_wipes_disk_tier(tmp_path):
+    eng = _engine(tmp_path)
+    cdir = tmp_path / "c0"
+    size = BLOCK * 2
+    HOTCACHE.configure(enable=True, mem_bytes=BLOCK // 2,
+                       disk_bytes=1 << 30, dirs=[str(cdir)],
+                       min_hits=1, max_object_bytes=size * 2,
+                       revalidate_s=3600.0)
+    eng.make_bucket("b")
+    body = b"w" * size
+    eng.put_object("b", "k", body)
+    assert eng.get_object("b", "k")[0] == body
+    assert HOTCACHE.snapshot()["diskEntries"] == 1
+    # Dir change: the old tier is wiped (cache files are ephemeral),
+    # the index starts empty, serving keeps working.
+    cdir2 = tmp_path / "c1"
+    HOTCACHE.configure(enable=True, mem_bytes=BLOCK // 2,
+                       disk_bytes=1 << 30, dirs=[str(cdir2)],
+                       min_hits=1, max_object_bytes=size * 2,
+                       revalidate_s=3600.0)
+    assert HOTCACHE.snapshot()["diskEntries"] == 0
+    assert eng.get_object("b", "k")[0] == body
 
 
-def test_watermark_gc(tmp_path):
-    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)]
-    backend = ErasureObjects(disks, block_size=64 * 1024)
-    cache = CacheObjectLayer(backend, CacheConfig(
-        drives=[str(tmp_path / "c0")], quota_bytes=100_000,
-        high_watermark=90, low_watermark=50))
-    cache.make_bucket("gc")
-    for i in range(20):
-        cache.put_object("gc", f"o{i}", bytes([i]) * 10_000)
-        cache.get_object("gc", f"o{i}")  # populate ~10KB each
-    drive = cache.drives[0]
-    # GC kept usage under the low watermark after crossing high.
-    assert drive.usage_bytes() <= 100_000 * 0.9
-    # Backend still has everything.
-    for i in range(20):
-        assert backend.get_object("gc", f"o{i}")[0] == bytes([i]) * 10_000
-
-
-def test_server_with_cache_and_admin_stats(tmp_path):
-    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)]
-    backend = ErasureObjects(disks, block_size=64 * 1024)
-    cache = CacheObjectLayer(backend, CacheConfig(
-        drives=[str(tmp_path / "c0")]))
-    srv = S3Server(cache, ACCESS, SECRET)
-    port = srv.start()
-    try:
-        c = S3Client("127.0.0.1", port, ACCESS, SECRET)
-        c.make_bucket("srvc")
-        c.put_object("srvc", "k", b"through-the-stack")
-        assert c.get_object("srvc", "k").body == b"through-the-stack"
-        assert c.get_object("srvc", "k").body == b"through-the-stack"
-        r = c.request("GET", "/minio-tpu/admin/v1/cache-stats")
-        doc = json.loads(r.body)
-        assert doc["enabled"] is True
-        assert sum(d["hits"] for d in doc["drives"]) >= 1
-    finally:
-        srv.stop()
-
-
-def test_version_reads_bypass_cache(stack):
-    backend, cache, _ = stack
-    cache.make_bucket("ver")
-    i1 = cache.put_object("ver", "k", b"v1", versioned=True)
-    cache.put_object("ver", "k", b"v2", versioned=True)
-    data, _ = cache.get_object("ver", "k", version_id=i1.version_id)
-    assert data == b"v1"
+def test_env_only_cache_path_is_dead(monkeypatch, capsys):
+    """MINIO_CACHE_DRIVES no longer constructs a wrapper layer — it
+    warns and returns the layer unchanged (migration note: config-KV
+    `cache` subsystem is the only configuration path)."""
+    from minio_tpu.__main__ import _maybe_wrap_cache
+    monkeypatch.setenv("MINIO_CACHE_DRIVES", "/tmp/x,/tmp/y")
+    sentinel = object()
+    assert _maybe_wrap_cache(sentinel) is sentinel
+    err = capsys.readouterr().err
+    assert "MINIO_CACHE_DRIVES" in err and "cache enable=on" in err
+    # And the old wrapper really is gone.
+    with pytest.raises(ImportError):
+        from minio_tpu.cache import CacheObjectLayer  # noqa: F401

@@ -1,0 +1,665 @@
+"""Hot-object serving tier (cache/hotcache.py): tier hits without disk
+I/O, the single-flight counting-disk proof, invalidation races
+(overwrite-during-fill, delete-during-coalesced-wait, lost peer
+invalidation caught by ETag revalidation), QoS-aware admission, disk
+tier + eviction pinning, and the config-KV / peer-RPC wiring."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from minio_tpu.cache.hotcache import HOTCACHE
+from minio_tpu.erasure.engine import ErasureObjects, ObjectNotFound
+from minio_tpu.obs.metrics2 import METRICS2
+from minio_tpu.storage.xl import XLStorage
+
+BLOCK = 64 * 1024
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    """Every test starts and ends with the process-wide cache empty
+    and DISABLED (the default mode for the rest of the suite)."""
+    HOTCACHE.reset()
+    HOTCACHE.peer_notify = None
+    yield
+    HOTCACHE.configure(enable=False, mem_bytes=128 << 20,
+                       disk_bytes=1 << 30, dirs=[], min_hits=1,
+                       max_object_bytes=32 << 20, revalidate_s=1.0)
+    HOTCACHE.reset()
+    HOTCACHE.peer_notify = None
+
+
+def _enable(**over):
+    cfg = dict(enable=True, mem_bytes=64 << 20, disk_bytes=1 << 30,
+               dirs=[], min_hits=1, max_object_bytes=8 << 20,
+               revalidate_s=3600.0)
+    cfg.update(over)
+    HOTCACHE.configure(**cfg)
+
+
+class _Disk:
+    """Delegating disk wrapper: records read calls into a shared list
+    and optionally gates read_file on an event (so tests can hold a
+    fill mid-flight deterministically)."""
+
+    def __init__(self, inner, calls: list, gate=None, entered=None):
+        self._inner = inner
+        self._calls = calls
+        self._gate = gate
+        self._entered = entered
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if name in ("read_file", "read_version") and callable(attr):
+            def wrapped(*a, _name=name, _attr=attr, **kw):
+                self._calls.append(_name)
+                if _name == "read_file":
+                    if self._entered is not None:
+                        self._entered.set()
+                    if self._gate is not None and not self._gate.wait(20):
+                        raise RuntimeError("test gate timed out")
+                return _attr(*a, **kw)
+            return wrapped
+        return attr
+
+    def __repr__(self):
+        return repr(self._inner)
+
+
+def _engine(tmp_path, calls=None, gate=None, entered=None, n=6, k=4):
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(n)]
+    if calls is not None:
+        disks = [_Disk(d, calls, gate, entered) for d in disks]
+    eng = ErasureObjects(disks, k, n - k, block_size=BLOCK)
+    # Deterministic read counts: no hedged backup reads in tests.
+    eng.hedge_enabled = False
+    return eng
+
+
+def _wait(pred, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _m(name, labels=None):
+    return METRICS2.get(name, labels)
+
+
+class _MDelta:
+    """METRICS2 is cumulative across the whole suite: assertions must
+    compare against a baseline taken inside the test."""
+
+    def __init__(self, name, labels=None):
+        self._name, self._labels = name, labels
+        self._base = _m(name, labels)
+
+    def value(self):
+        return _m(self._name, self._labels) - self._base
+
+
+# ---------------------------------------------------------------------------
+# tier hits
+
+
+def test_mem_hit_serves_without_any_disk_io(tmp_path):
+    calls: list = []
+    eng = _engine(tmp_path, calls)
+    _enable()
+    eng.make_bucket("b")
+    body = b"H" * (BLOCK * 2 + 777)
+    eng.put_object("b", "hot", body)
+    data, info = eng.get_object("b", "hot")     # miss -> fill
+    assert data == body
+    before = len(calls)
+    data, info2 = eng.get_object("b", "hot")    # pure memory hit
+    assert data == body and info2.etag == info.etag
+    assert len(calls) == before, "a mem hit must touch no disk"
+    # The stat half of a hot GET skips the metadata fan-out too.
+    assert eng.get_object_info("b", "hot").etag == info.etag
+    assert len(calls) == before
+    snap = HOTCACHE.snapshot()
+    assert snap["counters"]["hit_mem"] >= 1
+    assert snap["counters"]["fill"] == 1
+
+
+def test_range_hit_served_from_mem_slice(tmp_path):
+    calls: list = []
+    eng = _engine(tmp_path, calls)
+    _enable()
+    eng.make_bucket("b")
+    body = bytes(range(256)) * (BLOCK // 128)
+    eng.put_object("b", "r", body)
+    eng.get_object("b", "r")                    # fill
+    before = len(calls)
+    data, _ = eng.get_object("b", "r", offset=100, length=5000)
+    assert data == body[100:5100]
+    assert len(calls) == before
+
+
+def test_disabled_cache_is_inert(tmp_path):
+    calls: list = []
+    eng = _engine(tmp_path, calls)
+    eng.make_bucket("b")
+    eng.put_object("b", "k", b"x" * BLOCK)
+    r1 = len([c for c in calls if c == "read_file"])
+    assert eng.get_object("b", "k")[0] == b"x" * BLOCK
+    assert eng.get_object("b", "k")[0] == b"x" * BLOCK
+    r2 = len([c for c in calls if c == "read_file"])
+    assert r2 >= r1 + 8, "disabled cache must not absorb reads"
+    assert HOTCACHE.snapshot()["counters"]["fill"] == 0
+
+
+# ---------------------------------------------------------------------------
+# single-flight
+
+
+def test_concurrent_cold_gets_pay_exactly_one_erasure_read(tmp_path):
+    """The counting-disk proof: N concurrent cold GETs of one key
+    perform exactly ONE erasure read (k shard reads, one fill); the
+    other N-1 coalesce onto the filling entry."""
+    calls: list = []
+    gate, entered = threading.Event(), threading.Event()
+    eng = _engine(tmp_path, calls, gate, entered)
+    _enable()
+    eng.make_bucket("b")
+    body = b"Z" * (BLOCK + 13)
+    gate.set()                       # writes are not gated reads
+    eng.put_object("b", "one", body)
+    calls.clear()
+    gate.clear()
+
+    results: list = []
+    errors: list = []
+
+    def get():
+        try:
+            results.append(eng.get_object("b", "one")[0])
+        except BaseException as e:   # noqa: BLE001 - surface in test
+            errors.append(e)
+
+    t1 = threading.Thread(target=get, daemon=True)
+    t1.start()
+    # The filler registers its fill, then blocks inside read_file.
+    _wait(lambda: entered.is_set(), msg="filler to reach read_file")
+    _wait(lambda: HOTCACHE.snapshot()["fillsInFlight"] == 1,
+          msg="fill registration")
+    rest = [threading.Thread(target=get, daemon=True) for _ in range(7)]
+    for t in rest:
+        t.start()
+    _wait(lambda: HOTCACHE.snapshot()["counters"]["coalesced"] == 7,
+          msg="7 coalesced waiters")
+    gate.set()
+    for t in [t1] + rest:
+        t.join(timeout=30)
+        assert not t.is_alive()
+    assert not errors, errors
+    assert results == [body] * 8
+    reads = [c for c in calls if c == "read_file"]
+    assert len(reads) == 4, (
+        f"8 concurrent cold GETs must cost exactly k=4 shard reads, "
+        f"saw {len(reads)}")
+    snap = HOTCACHE.snapshot()
+    assert snap["counters"]["coalesced"] == 7
+    assert snap["counters"]["fill"] == 1
+    # And the key is now resident: one more GET is a pure hit.
+    before = len(calls)
+    assert eng.get_object("b", "one")[0] == body
+    assert len(calls) == before
+
+
+def test_waiter_falls_back_when_filler_abandons(tmp_path):
+    """A filler whose client walks away mid-stream must wake its
+    waiters, who transparently re-read on their own — no orphaned
+    waiters, no torn responses."""
+    eng = _engine(tmp_path)
+    _enable()
+    eng.make_bucket("b")
+    body = b"W" * (BLOCK * 3)
+    eng.put_object("b", "k", body)
+    aband = _MDelta("minio_tpu_v2_cache_fills_total",
+                    {"result": "abandoned"})
+    fallb = _MDelta("minio_tpu_v2_cache_fills_total",
+                    {"result": "waiter_fallback"})
+    info, stream = eng.get_object_stream("b", "k")   # registers fill
+    assert HOTCACHE.snapshot()["fillsInFlight"] == 1
+    got: list = []
+    t = threading.Thread(
+        target=lambda: got.append(eng.get_object("b", "k")[0]),
+        daemon=True)
+    t.start()
+    _wait(lambda: HOTCACHE.snapshot()["counters"]["coalesced"] == 1,
+          msg="waiter join")
+    stream.close()                    # filler's client abandons
+    t.join(timeout=30)
+    assert not t.is_alive()
+    assert got == [body]
+    assert aband.value() == 1
+    assert fallb.value() == 1
+
+
+# ---------------------------------------------------------------------------
+# invalidation
+
+
+def test_overwrite_then_delete_invalidate(tmp_path):
+    eng = _engine(tmp_path)
+    _enable()
+    eng.make_bucket("b")
+    eng.put_object("b", "k", b"v1" * BLOCK)
+    assert eng.get_object("b", "k")[0] == b"v1" * BLOCK
+    assert eng.get_object("b", "k")[0] == b"v1" * BLOCK   # cached
+    eng.put_object("b", "k", b"v2" * BLOCK)
+    assert eng.get_object("b", "k")[0] == b"v2" * BLOCK
+    eng.delete_object("b", "k")
+    with pytest.raises(ObjectNotFound):
+        eng.get_object("b", "k")
+    assert HOTCACHE.snapshot()["counters"]["invalidate"] >= 2
+
+
+def test_invalidation_during_fill_discards_entry(tmp_path):
+    """Overwrite-during-fill (the peer-race shape): an invalidation
+    arriving while a fill streams poisons it — the bytes are served to
+    the in-flight readers (normal concurrent-read semantics) but the
+    entry is never retained."""
+    calls: list = []
+    gate, entered = threading.Event(), threading.Event()
+    eng = _engine(tmp_path, calls, gate, entered)
+    _enable()
+    eng.make_bucket("b")
+    body = b"OLD" * BLOCK
+    gate.set()
+    eng.put_object("b", "k", body)
+    gate.clear()
+    inval = _MDelta("minio_tpu_v2_cache_fills_total",
+                    {"result": "invalidated"})
+    out: list = []
+    t = threading.Thread(
+        target=lambda: out.append(eng.get_object("b", "k")[0]),
+        daemon=True)
+    t.start()
+    _wait(lambda: entered.is_set() and
+          HOTCACHE.snapshot()["fillsInFlight"] == 1,
+          msg="fill in flight")
+    # A peer overwrote the key: its invalidation lands mid-fill.
+    HOTCACHE.invalidate("b", "k", propagate=False, source="peer")
+    gate.set()
+    t.join(timeout=30)
+    assert out == [body]
+    assert inval.value() == 1
+    # Nothing was retained: the next GET reads disks again.
+    before = len([c for c in calls if c == "read_file"])
+    assert eng.get_object("b", "k")[0] == body
+    assert len([c for c in calls if c == "read_file"]) > before
+
+
+def test_disable_mid_fill_never_admits(tmp_path):
+    """A config disable while a fill streams must not park the
+    finished fill's bytes in a cache nothing consults anymore."""
+    calls: list = []
+    gate, entered = threading.Event(), threading.Event()
+    eng = _engine(tmp_path, calls, gate, entered)
+    _enable()
+    eng.make_bucket("b")
+    body = b"off" * BLOCK
+    gate.set()
+    eng.put_object("b", "k", body)
+    gate.clear()
+    out: list = []
+    t = threading.Thread(
+        target=lambda: out.append(eng.get_object("b", "k")[0]),
+        daemon=True)
+    t.start()
+    _wait(lambda: entered.is_set() and
+          HOTCACHE.snapshot()["fillsInFlight"] == 1,
+          msg="fill in flight")
+    _enable(enable=False)            # operator disables mid-fill
+    gate.set()
+    t.join(timeout=30)
+    assert out == [body]
+    snap = HOTCACHE.snapshot()
+    assert snap["memEntries"] == 0 and snap["memBytesUsed"] == 0, snap
+
+
+def test_delete_during_coalesced_wait(tmp_path):
+    """delete-during-coalesced-wait: the delete serializes behind the
+    fill's read lock, the coalesced waiters stream the pre-delete
+    bytes, and the delete's invalidation keeps the entry from
+    surviving — the next GET 404s."""
+    calls: list = []
+    gate, entered = threading.Event(), threading.Event()
+    eng = _engine(tmp_path, calls, gate, entered)
+    _enable()
+    eng.make_bucket("b")
+    body = b"D" * (BLOCK * 2)
+    gate.set()
+    eng.put_object("b", "k", body)
+    gate.clear()
+    out: list = []
+    errs: list = []
+
+    def get():
+        try:
+            out.append(eng.get_object("b", "k")[0])
+        except BaseException as e:   # noqa: BLE001
+            errs.append(e)
+
+    t1 = threading.Thread(target=get, daemon=True)
+    t1.start()
+    _wait(lambda: entered.is_set(), msg="filler blocked in read")
+    t2 = threading.Thread(target=get, daemon=True)
+    t2.start()
+    _wait(lambda: HOTCACHE.snapshot()["counters"]["coalesced"] == 1,
+          msg="coalesced waiter")
+    deleted = threading.Event()
+
+    def delete():
+        eng.delete_object("b", "k")
+        deleted.set()
+
+    t3 = threading.Thread(target=delete, daemon=True)
+    t3.start()
+    time.sleep(0.1)
+    assert not deleted.is_set(), \
+        "delete must serialize behind the fill's read lock"
+    gate.set()
+    for t in (t1, t2, t3):
+        t.join(timeout=30)
+        assert not t.is_alive()
+    assert not errs, errs
+    assert out == [body, body]
+    with pytest.raises(ObjectNotFound):
+        eng.get_object("b", "k")
+
+
+def test_lost_peer_invalidation_caught_by_etag_revalidation(tmp_path):
+    """Two 'nodes' (engines) over the same disks. Node B overwrites
+    the key but its invalidation push to node A is LOST. A's memory
+    entry serves stale only inside its revalidation window; once the
+    window lapses (or with revalidate=0), the ETag check catches the
+    change and A serves the new bytes."""
+    stale = _MDelta("minio_tpu_v2_cache_stale_total",
+                    {"tier": "mem"})
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(6)]
+    a = ErasureObjects(disks, 4, 2, block_size=BLOCK)
+    b = ErasureObjects(disks, 4, 2, block_size=BLOCK)
+    a.hedge_enabled = b.hedge_enabled = False
+    _enable(revalidate_s=3600.0)
+    a.make_bucket("b")
+    v1, v2 = b"one" * BLOCK, b"two" * BLOCK
+    a.put_object("b", "k", v1)
+    assert a.get_object("b", "k")[0] == v1
+    assert a.get_object("b", "k")[0] == v1        # cached on A
+
+    # B overwrites; the peer invalidation never arrives (lost RPC).
+    real = HOTCACHE.invalidate
+    HOTCACHE.invalidate = lambda *args, **kw: None
+    try:
+        b.put_object("b", "k", v2)
+    finally:
+        HOTCACHE.invalidate = real
+
+    # Inside the trust window the stale copy is still served — that
+    # window IS the documented worst-case staleness bound.
+    assert a.get_object("b", "k")[0] == v1
+    # Window elapsed (revalidate=0 -> every hit revalidates): the
+    # ETag check catches the lost invalidation, drops the entry, and
+    # the GET serves the new bytes.
+    _enable(revalidate_s=0.0)
+    assert a.get_object("b", "k")[0] == v2
+    assert stale.value() == 1
+    a.shutdown()
+    b.shutdown()
+
+
+def test_multipart_complete_invalidates(tmp_path):
+    eng = _engine(tmp_path)
+    _enable()
+    eng.make_bucket("b")
+    eng.put_object("b", "k", b"plain" * BLOCK)
+    assert eng.get_object("b", "k")[0] == b"plain" * BLOCK
+    assert eng.get_object("b", "k")[0] == b"plain" * BLOCK
+    up = eng.multipart.new_multipart_upload("b", "k", {})
+    part_body = b"mp" * BLOCK
+    part = eng.multipart.put_object_part("b", "k", up, 1, part_body)
+    eng.multipart.complete_multipart_upload("b", "k", up,
+                                            [(1, part["etag"])])
+    assert eng.get_object("b", "k")[0] == part_body
+
+
+def test_peer_rpc_and_notify_wiring(tmp_path):
+    """The engine's local invalidation pushes (bucket, key, epoch) to
+    peers; the receiving side's RPC applies without re-propagation."""
+    from minio_tpu.rpc.peer import PeerRPCService
+    eng = _engine(tmp_path)
+    _enable()
+    pushed: list = []
+    HOTCACHE.peer_notify = lambda b, k, e: pushed.append((b, k, e))
+    eng.make_bucket("b")
+    eng.put_object("b", "k", b"x" * BLOCK)
+    assert eng.get_object("b", "k")[0] == b"x" * BLOCK
+    eng.put_object("b", "k", b"y" * BLOCK)      # overwrite -> push
+    assert pushed and pushed[-1][:2] == ("b", "k")
+    assert pushed[-1][2] >= 1
+    # Receiving side: cache the key again, then apply the peer RPC.
+    assert eng.get_object("b", "k")[0] == b"y" * BLOCK
+    assert eng.get_object("b", "k")[0] == b"y" * BLOCK
+    assert HOTCACHE.snapshot()["memEntries"] == 1
+    svc = PeerRPCService("topo")
+    res, _ = svc.rpc_cache_invalidate(
+        {"bucket": "b", "key": "k", "epoch": 7}, b"")
+    assert res == {"ok": True}
+    assert HOTCACHE.snapshot()["memEntries"] == 0
+    assert _m("minio_tpu_v2_cache_invalidations_total",
+              {"source": "peer"}) >= 1
+
+
+# ---------------------------------------------------------------------------
+# admission / QoS
+
+
+def test_background_lane_neither_fills_nor_counts(tmp_path):
+    from minio_tpu.qos.scheduler import background_lane
+    eng = _engine(tmp_path)
+    _enable()
+    eng.make_bucket("b")
+    body = b"bg" * BLOCK
+    eng.put_object("b", "k", body)
+    with background_lane():
+        assert eng.get_object("b", "k")[0] == body
+        assert eng.get_object("b", "k")[0] == body
+    snap = HOTCACHE.snapshot()
+    assert snap["counters"]["fill"] == 0
+    assert snap["memEntries"] == 0
+    # Foreground traffic still fills normally afterwards.
+    assert eng.get_object("b", "k")[0] == body
+    assert HOTCACHE.snapshot()["counters"]["fill"] == 1
+
+
+def test_min_hits_admission_floor(tmp_path):
+    eng = _engine(tmp_path)
+    _enable(min_hits=3)
+    unc = _MDelta("minio_tpu_v2_cache_fills_total",
+                  {"result": "uncached"})
+    eng.make_bucket("b")
+    body = b"m" * BLOCK
+    eng.put_object("b", "k", body)
+    for _ in range(2):
+        assert eng.get_object("b", "k")[0] == body
+    assert HOTCACHE.snapshot()["memEntries"] == 0
+    assert unc.value() == 2
+    assert eng.get_object("b", "k")[0] == body    # 3rd: admitted
+    assert HOTCACHE.snapshot()["memEntries"] == 1
+
+
+def test_scan_cannot_flush_the_hot_set(tmp_path):
+    """TinyLFU admission: a one-pass scan of many cold keys loses to
+    the resident hot entry (victim frequency beats candidate), so the
+    hot key keeps hitting after the scan."""
+    calls: list = []
+    eng = _engine(tmp_path, calls)
+    # Memory fits ~2 entries of BLOCK bytes + overhead.
+    _enable(mem_bytes=int(BLOCK * 2.5))
+    eng.make_bucket("b")
+    hot = b"h" * BLOCK
+    eng.put_object("b", "hot", hot)
+    for _ in range(6):
+        assert eng.get_object("b", "hot")[0] == hot
+    for i in range(20):                 # the scan: each key read once
+        eng.put_object("b", f"scan-{i}", b"s" * BLOCK)
+        eng.get_object("b", f"scan-{i}")
+    before = len(calls)
+    assert eng.get_object("b", "hot")[0] == hot
+    assert len(calls) == before, "the scan flushed the hot entry"
+
+
+# ---------------------------------------------------------------------------
+# disk tier
+
+
+def test_disk_tier_demotion_range_pread_and_revalidation(tmp_path):
+    """Memory-pressure demotes LRU entries to the disk tier; a disk
+    hit serves ranges by seeking (never materializing the entry) and
+    ALWAYS revalidates the ETag via a metadata read."""
+    calls: list = []
+    eng = _engine(tmp_path, calls)
+    cdir = tmp_path / "cachedir"
+    dhit = _MDelta("minio_tpu_v2_cache_hits_total", {"tier": "disk"})
+    _enable(mem_bytes=int(BLOCK * 1.5), dirs=[str(cdir)])
+    eng.make_bucket("b")
+    b1, b2 = b"1" * BLOCK, b"2" * BLOCK
+    eng.put_object("b", "k1", b1)
+    eng.put_object("b", "k2", b2)
+    assert eng.get_object("b", "k1")[0] == b1     # fills mem
+    assert eng.get_object("b", "k2")[0] == b2     # evicts k1 -> disk
+    snap = HOTCACHE.snapshot()
+    assert snap["diskEntries"] == 1 and snap["memEntries"] == 1
+    files = list((cdir / "mtpu-cache").rglob("*"))
+    assert any(f.is_file() and not f.name.endswith(".meta")
+               for f in files)
+    reads_before = len([c for c in calls if c == "read_file"])
+    meta_before = len([c for c in calls if c == "read_version"])
+    data, _ = eng.get_object("b", "k1", offset=17, length=4096)
+    assert data == b1[17:17 + 4096]
+    assert len([c for c in calls if c == "read_file"]) == reads_before, \
+        "disk-tier hit must not read shards"
+    assert len([c for c in calls if c == "read_version"]) > meta_before, \
+        "disk-tier hit must revalidate the ETag"
+    assert dhit.value() == 1
+
+
+def test_eviction_under_concurrent_reader_pins_entry(tmp_path):
+    """An evicted disk-tier entry stays readable until the last
+    in-flight reader drains; the file is unlinked only then."""
+    eng = _engine(tmp_path)
+    cdir = tmp_path / "cachedir"
+    big = BLOCK * 8                       # several DISK read chunks
+    _enable(mem_bytes=BLOCK, dirs=[str(cdir)],
+            max_object_bytes=big * 2)
+    eng.make_bucket("b")
+    body = bytes(range(256)) * (big // 256)
+    eng.put_object("b", "big", body)
+    eng.get_object("b", "big")            # fill -> too big for mem ->
+    _wait(lambda: HOTCACHE.snapshot()["diskEntries"] == 1,
+          msg="disk demotion")
+    path = next(f for f in (cdir / "mtpu-cache").rglob("*")
+                if f.is_file() and not f.name.endswith(".meta"))
+    info, stream = eng.get_object_stream("b", "big")
+    first = next(stream)                  # reader holds a pin
+    assert body.startswith(first)
+    HOTCACHE.invalidate("b", "big", propagate=False)
+    assert path.exists(), "pinned entry must not be unlinked"
+    rest = first + b"".join(stream)       # reader drains fine
+    assert rest == body
+    _wait(lambda: not path.exists(), msg="deferred unlink")
+    assert HOTCACHE.snapshot()["diskEntries"] == 0
+
+
+def test_unhealthy_dir_gets_no_placement(tmp_path, monkeypatch):
+    """Drivemon-informed placement: a dir on a quarantined drive
+    neither receives new cache files nor serves existing entries."""
+    from minio_tpu.obs import drivemon as dm
+    eng = _engine(tmp_path)
+    cdir = tmp_path / "d0" / "cache"      # rides on engine disk d0
+    _enable(mem_bytes=BLOCK, dirs=[str(cdir)],
+            max_object_bytes=4 << 20)
+    eng.make_bucket("b")
+    body = b"q" * (BLOCK * 2)             # > mem -> wants the disk tier
+    eng.put_object("b", "k", body)
+    # Quarantine the backing drive BEFORE the fill demotes.
+    ep = eng.endpoints[0]
+    assert str(tmp_path / "d0") in ep
+    dm.DRIVEMON.quarantine(ep, "test")
+    try:
+        HOTCACHE._dir_eps.clear()
+        assert eng.get_object("b", "k")[0] == body
+        assert HOTCACHE.snapshot()["diskEntries"] == 0, \
+            "no cache files may land on a quarantined drive"
+    finally:
+        dm.DRIVEMON.reset()
+
+
+# ---------------------------------------------------------------------------
+# server wiring
+
+
+def test_config_kv_live_reload_and_stats_endpoint(tmp_path):
+    from minio_tpu.s3.client import S3Client
+    from minio_tpu.s3.server import S3Server
+    eng = _engine(tmp_path)
+    srv = S3Server(eng, "hotadm", "hotadm-secret")
+    port = srv.start()
+    try:
+        c = S3Client("127.0.0.1", port, "hotadm", "hotadm-secret")
+        c.make_bucket("cbkt")
+        body = b"srv" * BLOCK
+        assert c.put_object("cbkt", "k", body).status == 200
+        srv.config.set_kv("cache enable=on mem_bytes=16777216 "
+                          "min_hits=1 revalidate=1s")
+        assert HOTCACHE.enabled
+        assert c.get_object("cbkt", "k").body == body   # fill
+        assert c.get_object("cbkt", "k").body == body   # hit
+        r = c.request("GET", "/minio-tpu/admin/v1/cache-stats")
+        doc = json.loads(r.body)
+        assert doc["enabled"] is True
+        assert doc["counters"]["hit_mem"] >= 1
+        assert doc["memEntries"] == 1
+        # Overwrite through the server invalidates before serving.
+        assert c.put_object("cbkt", "k", b"new" * BLOCK).status == 200
+        assert c.get_object("cbkt", "k").body == b"new" * BLOCK
+        # Disabling clears both tiers, live.
+        srv.config.set_kv("cache enable=off")
+        assert not HOTCACHE.enabled
+        doc = json.loads(c.request(
+            "GET", "/minio-tpu/admin/v1/cache-stats").body)
+        assert doc["enabled"] is False and doc["memEntries"] == 0
+        # Bad values are rejected before they persist.
+        with pytest.raises(ValueError):
+            srv.config.set_kv("cache mem_bytes=lots")
+        with pytest.raises(ValueError):
+            srv.config.set_kv("cache revalidate=sometimes")
+    finally:
+        srv.stop()
+
+
+def test_timeline_carries_cache_row(tmp_path):
+    from minio_tpu.obs.timeline import Timeline
+    eng = _engine(tmp_path)
+    _enable()
+    eng.make_bucket("b")
+    eng.put_object("b", "k", b"t" * BLOCK)
+    tl = Timeline(period_s=0.05, retention_s=10)
+    tl.tick()                               # baseline
+    eng.get_object("b", "k")                # fill
+    eng.get_object("b", "k")                # hit
+    s = tl.tick()
+    assert s is not None
+    assert s["cacheHits"] >= 1
+    assert s["cacheFills"] >= 1
+    assert s["cacheBytes"] >= BLOCK

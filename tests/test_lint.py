@@ -131,6 +131,46 @@ def test_r2_accepts_with_finally_return_and_attribute_store():
     assert _check(ResourceLeakRule(), src) == []
 
 
+def test_r2_flags_orphaned_single_flight_fill():
+    # A registered fill that is never finished/aborted strands every
+    # coalesced waiter: the registration is a resource.
+    src = (
+        "def leak_fill(HOTCACHE, ns, b, k, info):\n"
+        "    fill = HOTCACHE.begin_fill(ns, b, k, info)\n"
+        "    if fill is None:\n"
+        "        return None\n"
+        "    return read_chunks()\n")
+    findings = _check(ResourceLeakRule(), src)
+    assert len(findings) == 1
+    assert "single-flight fill" in findings[0].message
+
+
+def test_r2_accepts_structurally_released_fill():
+    # The engine's real shape: abort in a finally unless ownership
+    # transferred into the reader stream; plus the plain-return
+    # transfer and try/finally abort shapes.
+    src = (
+        "def ok_handoff(HOTCACHE, ns, b, k, info, src_iter):\n"
+        "    fill = HOTCACHE.begin_fill(ns, b, k, info)\n"
+        "    handed = False\n"
+        "    try:\n"
+        "        rdr = fill.reader(src_iter)\n"
+        "        handed = True\n"
+        "        return rdr\n"
+        "    finally:\n"
+        "        if not handed:\n"
+        "            fill.abort(RuntimeError('setup failed'))\n"
+        "def ok_transfer(HOTCACHE, ns, b, k, info):\n"
+        "    return HOTCACHE.begin_fill(ns, b, k, info)\n"
+        "def ok_finally(HOTCACHE, ns, b, k, info):\n"
+        "    fill = HOTCACHE.begin_fill(ns, b, k, info)\n"
+        "    try:\n"
+        "        pump(fill)\n"
+        "    finally:\n"
+        "        fill.finish()\n")
+    assert _check(ResourceLeakRule(), src) == []
+
+
 # ---------------------------------------------------------------------------
 # R3 — no blocking calls under a mutex in hot-path modules
 

@@ -33,6 +33,48 @@ def _percentile(sorted_vals: list[float], q: float) -> float:
     return sorted_vals[idx]
 
 
+class _Zipf:
+    """Zipfian rank sampler: P(rank r) ~ 1/r^s over key_space ranks —
+    the canonical hot-key GET mix (rank 1 is the hottest key). Sampling
+    is an inverse-CDF bisect over the precomputed cumulative weights,
+    so per-request cost stays O(log keys)."""
+
+    def __init__(self, s: float, n: int):
+        import bisect as _b
+        self._bisect = _b.bisect_left
+        weights = [1.0 / ((r + 1) ** s) for r in range(n)]
+        total = sum(weights)
+        acc, cdf = 0.0, []
+        for w in weights:
+            acc += w / total
+            cdf.append(acc)
+        self._cdf = cdf
+
+    def sample(self, rng: random.Random) -> int:
+        return min(self._bisect(self._cdf, rng.random()),
+                   len(self._cdf) - 1)
+
+
+def _key_shares(counts: dict[str, int]) -> dict:
+    """Per-key-percentile concentration of the achieved mix: the
+    fraction of all requests that landed on the hottest 1% / 10% / 25%
+    of keys (how 'hot' the hot set really was — the number a cache hit
+    ratio should be judged against)."""
+    if not counts:
+        return {}
+    ranked = sorted(counts.values(), reverse=True)
+    total = sum(ranked)
+
+    def share(pct: float) -> float:
+        n = max(1, int(round(len(ranked) * pct / 100.0)))
+        return round(sum(ranked[:n]) / total, 4)
+
+    return {"distinct_keys": len(ranked),
+            "top1pct_share": share(1),
+            "top10pct_share": share(10),
+            "top25pct_share": share(25)}
+
+
 class _Pacer:
     """Token pacing toward a target QPS; qps <= 0 = closed loop (each
     worker fires as fast as its previous request completes)."""
@@ -58,18 +100,40 @@ def run_load(host: str, port: int, access_key: str, secret_key: str,
              bucket: str, *, concurrency: int = 8, duration: float = 5.0,
              qps: float = 0.0, put_fraction: float = 0.5,
              object_bytes: int = 1024 * 1024, key_prefix: str = "loadgen",
-             key_space: int = 32, seed: int = 0) -> dict:
+             key_space: int = 32, seed: int = 0,
+             zipf_s: float = 0.0, preload: bool = False) -> dict:
     """Drive mixed PUT/GET load; returns the aggregate report dict.
 
     GETs address keys the run has already PUT (a GET before any PUT
     completes falls back to a PUT), so the mix self-bootstraps on an
     empty bucket. Latencies are per-request wall time in milliseconds;
     every non-2xx status is counted by code, 503s also by error code
-    parsed from the XML body (SlowDown vs RequestTimeout)."""
+    parsed from the XML body (SlowDown vs RequestTimeout).
+
+    ``zipf_s`` > 0 switches key selection to a Zipfian rank
+    distribution over a SHARED key space of ``key_space`` keys
+    (``{key_prefix}/z{rank}``) — the realistic hot-key GET mix for
+    cache benchmarks; the report then carries the achieved per-key
+    concentration (``key_distribution``). ``preload`` PUTs the whole
+    key space once before the timed window (outside the stats), so a
+    pure-GET Zipfian run never 404s."""
     from minio_tpu.s3.client import S3Client
 
     body = bytes(bytearray(random.Random(seed).randbytes(object_bytes))
                  ) if object_bytes else b""
+    zipf = _Zipf(zipf_s, key_space) if zipf_s > 0 else None
+    if preload:
+        # Preloaded keys live in a SHARED namespace every worker GETs
+        # from (z{rank} for Zipf, p{n} uniform) — per-worker {wid}-{n}
+        # names would leave every worker but one 404ing.
+        pre = S3Client(host, port, access_key, secret_key)
+        for r in range(key_space):
+            key = (f"{key_prefix}/z{r}" if zipf is not None
+                   else f"{key_prefix}/p{r}")
+            resp = pre.put_object(bucket, key, body)
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"preload PUT {key} failed: {resp.status}")
     pacer = _Pacer(qps)
     stop_at = time.monotonic() + duration
     mu = threading.Lock()
@@ -77,6 +141,7 @@ def run_load(host: str, port: int, access_key: str, secret_key: str,
     lat_shed: list[float] = []
     status_counts: dict[int, int] = {}
     error_codes: dict[str, int] = {}
+    key_counts: dict[str, int] = {}
     put_keys: list[str] = []
     retry_after_seen = 0
 
@@ -86,15 +151,32 @@ def run_load(host: str, port: int, access_key: str, secret_key: str,
         client = S3Client(host, port, access_key, secret_key)
         while time.monotonic() < stop_at:
             pacer.wait()
-            do_put = rng.random() < put_fraction or not put_keys
-            key = f"{key_prefix}/{wid}-{rng.randrange(key_space)}"
+            # Bootstrap fallback: a GET with nothing to read yet PUTs
+            # instead, so the classic mix self-starts on an empty
+            # bucket. Zipf and preload runs assume the shared key
+            # space already exists and must NEVER write — a stray PUT
+            # would invalidate the very hot keys a cache bench just
+            # warmed.
+            do_put = rng.random() < put_fraction or (
+                not put_keys and not preload and zipf is None)
+            if zipf is not None:
+                key = f"{key_prefix}/z{zipf.sample(rng)}"
+            elif preload and not do_put:
+                key = f"{key_prefix}/p{rng.randrange(key_space)}"
+            else:
+                key = f"{key_prefix}/{wid}-{rng.randrange(key_space)}"
             t0 = time.perf_counter()
             try:
                 if do_put:
                     r = client.put_object(bucket, key, body)
                 else:
-                    with mu:
-                        gkey = rng.choice(put_keys)
+                    if zipf is not None or preload:
+                        gkey = key
+                    else:
+                        with mu:
+                            gkey = rng.choice(put_keys) if put_keys \
+                                else key
+                    key = gkey   # report the key actually requested
                     r = client.get_object(bucket, gkey)
                 status = r.status
             except Exception:
@@ -103,6 +185,7 @@ def run_load(host: str, port: int, access_key: str, secret_key: str,
             ms = (time.perf_counter() - t0) * 1e3
             with mu:
                 status_counts[status] = status_counts.get(status, 0) + 1
+                key_counts[key] = key_counts.get(key, 0) + 1
                 if 200 <= status < 300:
                     lat_ok.append(ms)
                     if do_put:
@@ -146,9 +229,11 @@ def run_load(host: str, port: int, access_key: str, secret_key: str,
             "max": round(lat_ok[-1], 3) if lat_ok else 0.0,
         },
         "elapsed_s": round(elapsed, 3),
+        "key_distribution": _key_shares(key_counts),
         "config": {"concurrency": concurrency, "duration_s": duration,
                    "qps_target": qps, "put_fraction": put_fraction,
-                   "object_bytes": object_bytes},
+                   "object_bytes": object_bytes, "key_space": key_space,
+                   "zipf_s": zipf_s},
     }
 
 
@@ -179,6 +264,13 @@ def main() -> int:
                    help="target QPS; 0 = closed loop")
     p.add_argument("--put-fraction", type=float, default=0.5)
     p.add_argument("--size", type=int, default=1024 * 1024)
+    p.add_argument("--key-space", type=int, default=32)
+    p.add_argument("--zipf", type=float, default=0.0,
+                   help="Zipfian key-rank exponent s (>0 enables the "
+                        "hot-key mix; try 1.1)")
+    p.add_argument("--preload", action="store_true",
+                   help="PUT the whole key space before the timed "
+                        "window (for pure-GET runs)")
     p.add_argument("--make-bucket", action="store_true")
     args = p.parse_args()
     if args.make_bucket:
@@ -190,7 +282,9 @@ def main() -> int:
                       concurrency=args.concurrency,
                       duration=args.duration, qps=args.qps,
                       put_fraction=args.put_fraction,
-                      object_bytes=args.size)
+                      object_bytes=args.size,
+                      key_space=args.key_space, zipf_s=args.zipf,
+                      preload=args.preload)
     print(json.dumps(report, indent=2))
     return 0
 

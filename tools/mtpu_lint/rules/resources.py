@@ -7,6 +7,12 @@ admission slots (a leaked slot permanently shrinks a cap), trace spans
 a bounded queue), and file handles. PR 2/3 both shipped release-path
 bugs of exactly this shape.
 
+Single-flight cache fill registrations (``HOTCACHE.begin_fill``) are a
+resource too: a registered fill that is never finished or aborted
+strands every coalesced waiter on its condition variable — a fill that
+raises must wake and fail its waiters, so the registration needs a
+structural release exactly like a file handle does.
+
 The rule flags an acquisition unless the exit path is structural:
 
 - used as a ``with`` context manager (directly or via a wrapper), or
@@ -46,6 +52,8 @@ def _acquisition_kind(node: ast.Call) -> str | None:
         base = dotted_name(func.value).lower()
         if "admission" in base:
             return "admission slot"
+    if tname == "begin_fill":
+        return "single-flight fill"
     return None
 
 

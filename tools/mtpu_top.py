@@ -104,6 +104,17 @@ def render(doc: dict, width: int = 60) -> str:
     tx = last.get("tx", 0) / dt(last) / (1 << 20)
     lines.append(f"rx {rx:.2f} MiB/s   tx {tx:.2f} MiB/s   "
                  f"admission queue {_num(last.get('queueDepth', 0))}")
+    # Hot-object cache row: hit ratio over the last window + resident
+    # bytes (the serving tier's live effectiveness at a glance).
+    ch = last.get("cacheHits", 0)
+    cm = last.get("cacheMisses", 0)
+    ratio = ch / (ch + cm) if (ch + cm) else 0.0
+    lines.append(
+        f"cache: hit/s {_num(ch / dt(last))}  "
+        f"miss/s {_num(cm / dt(last))}  "
+        f"fill/s {_num(last.get('cacheFills', 0) / dt(last))}  "
+        f"hit% {ratio * 100:.1f}  "
+        f"bytes {last.get('cacheBytes', 0) / (1 << 20):.1f} MiB")
     d = last.get("drives", {})
     lines.append(f"drives: suspect={d.get('suspect', 0)} "
                  f"faulty={d.get('faulty', 0)} "
