@@ -271,6 +271,7 @@ def bench_put_p50(np, workdir: str) -> dict:
         # together, the systematic recording cost survives.
         from minio_tpu.obs.drivemon import DRIVEMON
         from minio_tpu.obs.slowlog import SLOWLOG
+        from minio_tpu.obs.watchdog import WATCHDOG
         lat_on: list = []
         lat_off: list = []
         try:
@@ -280,7 +281,13 @@ def bench_put_p50(np, workdir: str) -> dict:
                 # pair stalls, allocator periodicity) into the delta.
                 order = (True, False) if i % 2 == 0 else (False, True)
                 for on in order:
+                    # The watchdog toggles with the other recorders:
+                    # its only request-path cost is the 5xx class
+                    # counter, but the paired measurement should cover
+                    # the whole PR-9 layer (sampler-tick evaluation
+                    # steals CPU on a 2-core box).
                     DRIVEMON.enabled = SLOWLOG.enabled = on
+                    WATCHDOG.enabled = on
                     t0 = time.perf_counter()
                     r = client.put_object(
                         "bench", f"obj-{i}-{int(on)}", body)
@@ -291,6 +298,7 @@ def bench_put_p50(np, workdir: str) -> dict:
                             f"PutObject failed: {r.status}")
         finally:
             DRIVEMON.enabled = SLOWLOG.enabled = True
+            WATCHDOG.enabled = True
         p50_ms = statistics.median(lat_on) * 1e3
         p50_off_ms = statistics.median(lat_off) * 1e3
         med_delta_ms = statistics.median(
@@ -644,16 +652,46 @@ def bench_qos_brownout(np, workdir: str) -> dict:
         # have been EXEMPTED (shed/deadline), not captured. (Raw 503
         # entry counts can't distinguish a leaked shed from a quorum
         # 503, which the slowlog deliberately captures.)
+        from minio_tpu.obs.metrics2 import METRICS2 as _M2
         from minio_tpu.obs.slowlog import SLOWLOG
+        from minio_tpu.obs.watchdog import WATCHDOG
         slowlog_before = SLOWLOG.total
         exempted_before = SLOWLOG.exempted
+        # Standing regression test for the watchdog itself: with fast
+        # sampling and short burn windows, the shed-rate built-in MUST
+        # fire during the brownout and resolve after it — the bench
+        # asserts the whole pending->firing->resolved loop against
+        # real overload, not synthetic samples.
+        shed_fired_before = _M2.get(
+            "minio_tpu_v2_alert_transitions_total",
+            {"rule": "shed_burn", "state": "firing"}) or 0
+        srv.config.set_kv("obs timeline_sample=250ms")
+        srv.config.set_kv("alerts fast_window=3s slow_window=30s "
+                          "pending_ticks=2 resolve_ticks=2")
         srv.config.set_kv(f"api requests_max_write={write_cap} "
                           "requests_deadline=250ms")
         brown = run_load("127.0.0.1", port, access, secret, "bench",
                          concurrency=4 * write_cap, duration=4.0,
                          put_fraction=1.0, object_bytes=len(body))
+        # The last shed-heavy samples are still inside the fast window:
+        # give the sampler a moment to evaluate them before the caps
+        # lift (the alert may already have fired mid-load).
+        shed_deadline = time.time() + 10
+        while (time.time() < shed_deadline
+               and (_M2.get("minio_tpu_v2_alert_transitions_total",
+                            {"rule": "shed_burn", "state": "firing"})
+                    or 0) <= shed_fired_before):
+            time.sleep(0.25)
         srv.config.set_kv("api requests_max_write=0 "
                           "requests_deadline=10s")
+        shed_alert_fired = (_M2.get(
+            "minio_tpu_v2_alert_transitions_total",
+            {"rule": "shed_burn", "state": "firing"}) or 0) \
+            - shed_fired_before
+        if shed_alert_fired < 1:
+            raise RuntimeError(
+                "shed-rate watchdog built-in never fired during the "
+                f"brownout (shed rate {brown['shed_rate']})")
         exempted = SLOWLOG.exempted - exempted_before
         if exempted < brown["shed_503"]:
             raise RuntimeError(
@@ -697,6 +735,17 @@ def bench_qos_brownout(np, workdir: str) -> dict:
         lat_off += put_lat("off2")
         p50_off = stats.median(lat_off) * 1e3
         p50_on = stats.median(lat_on) * 1e3
+        # The shed-rate alert must RESOLVE once the brownout is over:
+        # the heal-interference PUTs above ran shed-free, so the fast
+        # window has long cleared — poll out the resolve hysteresis.
+        resolve_deadline = time.time() + 30
+        while (time.time() < resolve_deadline
+               and WATCHDOG.state_of("shed_burn") != "ok"):
+            time.sleep(0.25)
+        if WATCHDOG.state_of("shed_burn") != "ok":
+            raise RuntimeError(
+                "shed-rate alert never resolved after the brownout: "
+                f"{WATCHDOG.snapshot()['alerts']}")
         from minio_tpu.obs.metrics2 import METRICS2
         return {
             "metric": "qos_brownout",
@@ -719,6 +768,10 @@ def bench_qos_brownout(np, workdir: str) -> dict:
             # Asserted above: every shed was slowlog-exempt.
             "slowlog_exempted_sheds": exempted,
             "slowlog_entries_during": SLOWLOG.total - slowlog_before,
+            # Asserted above: the shed-rate built-in fired during the
+            # brownout and resolved after it.
+            "shed_alert_fired": shed_alert_fired,
+            "shed_alert_resolved": True,
         }
     finally:
         srv.stop()
@@ -978,6 +1031,7 @@ def main() -> None:
     from minio_tpu.obs.drivemon import DRIVEMON
     from minio_tpu.obs.kernprof import KERNPROF
     from minio_tpu.obs.slowlog import SLOWLOG
+    from minio_tpu.obs.watchdog import WATCHDOG
     config_pipeline = {"put_p50": "put", "multipart": "put",
                        "get_2lost": "get", "heal": "heal"}
     configs: list[dict] = []
@@ -1005,6 +1059,10 @@ def main() -> None:
             # a suspect frozen from an earlier config's destroyed
             # disks must not leak into this config's tripwire.
             DRIVEMON.reset()
+            # The watchdog resets with it: a firing alert frozen from
+            # an earlier config's deliberate faults must not leak into
+            # this config's alerts_fired tripwire.
+            WATCHDOG.reset()
             before = PIPE_STATS.snapshot()
             slow_before = SLOWLOG.total
             mix_before = KERNPROF.mix_snapshot()
@@ -1032,6 +1090,12 @@ def main() -> None:
             suspect, faulty = DRIVEMON.counts()
             res["drive_suspect"] = suspect
             res["drive_faulty"] = faulty
+            # Watchdog tripwire (like drive_suspect): firing
+            # transitions during this config. qos_brownout fires the
+            # shed built-in BY DESIGN and asserts it resolves; any
+            # other config alerting is a silent regression surfaced
+            # in the BENCH record.
+            res["alerts_fired"] = WATCHDOG.fired_total
             configs.append(res)
         else:
             errors[name] = err or "unknown"

@@ -103,6 +103,31 @@ DEFAULT_KVS: dict[str, dict[str, str]] = {
         "max_object_bytes": "33554432",
         "revalidate": "1s",
     },
+    # Structured logging (logger/logger.py): json=on makes every
+    # console line a JSON object with structured fields (alert lines
+    # carry alert_id/rule join keys). MINIO_LOG_JSON=1 is the legacy
+    # env spelling and wins over config.
+    "logger": {
+        "json": "off",
+    },
+    # SLO watchdog (obs/watchdog.py): multi-window burn-rate alerting
+    # over the timeline ring plus built-in event rules (drive census,
+    # kernel backend down, MRF backlog, cache collapse, counter-reset
+    # storms) — default ON. `rules` is a JSON array of user threshold
+    # rules over registered metrics2 series (validated before
+    # persist); `webhook_endpoint` enables async alert delivery with
+    # bounded queue + bounded retry/backoff.
+    "alerts": {
+        "enable": "on",
+        "fast_window": "1m",
+        "slow_window": "15m",
+        "burn_threshold": "0.10",
+        "pending_ticks": "2",
+        "resolve_ticks": "3",
+        "rules": "",
+        "webhook_endpoint": "",
+        "webhook_auth_token": "",
+    },
     # Slow-request capture SLOs (obs/slowlog.py): any request past its
     # class threshold (ms) lands in the slowlog ring with per-layer
     # blame. Per-class keys override the default; empty = inherit;

@@ -1,14 +1,17 @@
 """Observability: span-based request tracing (span.py), the metrics-v2
 registry with node/cluster Prometheus endpoints (metrics2.py), TPU
 kernel accounting (kernel_stats.py), per-dispatch kernel profiling +
-backend health (kernprof.py), and the cluster timeline sample ring
-(timeline.py). See docs/observability.md."""
+backend health (kernprof.py), the cluster timeline sample ring
+(timeline.py), and the SLO watchdog + incident recorder
+(watchdog.py, incidents.py). See docs/observability.md."""
 
+from .incidents import INCIDENTS
 from .kernel_stats import KERNEL
 from .kernprof import KERNPROF
 from .metrics2 import METRICS2
 from .span import TRACER, current_span
 from .timeline import TIMELINE
+from .watchdog import WATCHDOG
 
-__all__ = ["KERNEL", "KERNPROF", "METRICS2", "TIMELINE", "TRACER",
-           "current_span"]
+__all__ = ["INCIDENTS", "KERNEL", "KERNPROF", "METRICS2", "TIMELINE",
+           "TRACER", "WATCHDOG", "current_span"]

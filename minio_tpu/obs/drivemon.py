@@ -327,10 +327,11 @@ class DriveMonitor:
         from .span import current_span
         quarantined = self.is_quarantined(endpoint)
         note = " [quarantined]" if quarantined else ""
+        red = redacted_endpoint(endpoint)
         Logger.get().info(
             f"drivemon: {endpoint} {old} -> {new}{note} "
-            f"(peer-relative score {score}x)", "drivemon")
-        red = redacted_endpoint(endpoint)
+            f"(peer-relative score {score}x)", "drivemon",
+            disk=red, state=new, quarantined=quarantined)
         METRICS2.set_gauge("minio_tpu_v2_drive_state",
                            {"disk": red}, _STATE_VALUE[new])
         METRICS2.inc("minio_tpu_v2_drive_state_transitions_total",

@@ -231,7 +231,7 @@ class KernelProfiler:
         from .span import current_span
         Logger.get().info(
             f"kernprof: backend {backend} {old} -> {new} ({cause})",
-            "kernprof")
+            "kernprof", backend=backend, state=new)
         METRICS2.set_gauge("minio_tpu_v2_kernel_backend_state",
                            {"backend": backend}, _STATE_VALUE[new])
         METRICS2.inc("minio_tpu_v2_kernel_backend_transitions_total",

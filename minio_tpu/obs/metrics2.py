@@ -424,3 +424,21 @@ METRICS2.register(
 METRICS2.register(
     "minio_tpu_v2_profile_bursts_total", "counter",
     "Profile-on-slow sampling bursts triggered by slow-rate spikes.")
+METRICS2.register(
+    "minio_tpu_v2_api_class_errors_total", "counter",
+    "Requests answered 5xx, by API class (the error-burn numerator; "
+    "per-API status detail lives on api_requests_total).")
+METRICS2.register(
+    "minio_tpu_v2_alerts_firing", "gauge",
+    "Watchdog alert state by rule (1 = firing, 0 = not).")
+METRICS2.register(
+    "minio_tpu_v2_alert_transitions_total", "counter",
+    "Watchdog alert lifecycle transitions, by rule and new state "
+    "(pending/firing/resolved).")
+METRICS2.register(
+    "minio_tpu_v2_alert_webhook_total", "counter",
+    "Alert webhook delivery outcomes, by result "
+    "(sent/failed/dropped).")
+METRICS2.register(
+    "minio_tpu_v2_incidents_total", "counter",
+    "Incident bundles frozen by firing alerts, by rule.")

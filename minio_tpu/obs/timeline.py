@@ -23,8 +23,11 @@ Counter-reset discipline: a delta that goes negative (registry reset,
 process restart behind a proxy) re-bases on the current value instead
 of emitting garbage negatives.
 
-The sampler tick also drives kernprof's rate-limited recovery probes —
-one thread owns all periodic kernel-health work.
+The sampler tick also drives kernprof's rate-limited recovery probes
+and the watchdog's alert evaluation (obs/watchdog.py) — one thread
+owns all periodic observability work.  Samples additionally carry the
+watchdog's burn-rate numerators (per-class 5xx/slowlog deltas), the
+count of counter re-bases this window, and the alert census.
 """
 
 from __future__ import annotations
@@ -127,6 +130,12 @@ class Timeline:
         while not stop_ev.wait(self.period_s):
             try:
                 self.tick()
+                # Watchdog evaluation rides the sampler tick — the
+                # rules read their burn windows from the ring this
+                # tick just appended to, so alerting needs no thread
+                # of its own and stops exactly when sampling stops.
+                from .watchdog import WATCHDOG
+                WATCHDOG.tick()
                 # Recovery probes ride the sampler tick but run on
                 # their own short-lived thread: a native probe can
                 # REBUILD the C++ lib (g++, up to ~2 min) and xla/
@@ -189,11 +198,23 @@ class Timeline:
         hedge = _series_sum(m("minio_tpu_v2_hedged_reads_total"),
                             by="result")
         suspect, faulty = DRIVEMON.counts()
+        from .watchdog import WATCHDOG
+        firing, pending, worst_rule = WATCHDOG.counts()
         return {
             "qps": _series_sum(m("minio_tpu_v2_qos_admission_wait_ms"),
                                by="class", field="count"),
             "shed": _series_sum(m("minio_tpu_v2_qos_shed_total"),
                                 by="class"),
+            # Watchdog numerators: 5xx + slowlog captures per class
+            # (shed above completes the trio of burn-rate signals).
+            "errors": _series_sum(
+                m("minio_tpu_v2_api_class_errors_total"), by="class"),
+            "slow": _series_sum(m("minio_tpu_v2_slow_requests_total"),
+                                by="class"),
+            # Alert census at sample time (gauge-like, not delta'd):
+            # rendered by mtpu_top and summed by the cluster merge.
+            "alerts": {"firing": firing, "pending": pending,
+                       "worst": worst_rule},
             "inflight": _series_sum(
                 m("minio_tpu_v2_qos_admission_inflight"), by="class"),
             "queueDepth": _series_sum(
@@ -217,13 +238,6 @@ class Timeline:
             "backendState": KERNPROF.states(),
         }
 
-    @staticmethod
-    def _delta(cur: float, prev: float) -> float:
-        """Counter delta, reset-safe: a counter that went DOWN was
-        reset — re-base on its current value, never emit a negative."""
-        d = cur - prev
-        return cur if d < 0 else d
-
     def tick(self, now: float | None = None) -> dict | None:
         """Take one sample (sampler thread; tests call directly).
         The first tick only establishes the baseline."""
@@ -239,6 +253,20 @@ class Timeline:
             worst_kern, self._worst_kern = self._worst_kern, None
             if prev is None:
                 return None
+            # Counter delta, reset-safe: a counter that went DOWN was
+            # reset — re-base on its current value, never emit a
+            # negative. Re-bases are COUNTED into the sample: a storm
+            # of them is itself a signal (watchdog counter_resets).
+            resets = 0
+
+            def _d(cur: float, prev_v: float) -> float:
+                nonlocal resets
+                d = cur - prev_v
+                if d < 0:
+                    resets += 1
+                    return cur
+                return d
+
             dt = max(now - prev.get("_t", now - self.period_s), 1e-9)
             sample: dict = {
                 "t": round(now, 3),
@@ -247,36 +275,51 @@ class Timeline:
                 # nominal period — the sampler drifts under load,
                 # which is exactly when an operator is watching.
                 "dt": round(dt, 3),
-                "qps": {c: self._delta(raw["qps"].get(c, 0),
-                                       prev["qps"].get(c, 0))
+                "qps": {c: _d(raw["qps"].get(c, 0),
+                              prev["qps"].get(c, 0))
                         for c in _CLASSES},
-                "shed": {c: self._delta(raw["shed"].get(c, 0),
-                                        prev["shed"].get(c, 0))
+                "shed": {c: _d(raw["shed"].get(c, 0),
+                               prev["shed"].get(c, 0))
+                         for c in _CLASSES},
+                # Burn-rate numerators (watchdog): 5xx + slowlog
+                # captures, same per-class delta discipline as qps.
+                "errors": {c: _d((raw.get("errors") or {}).get(c, 0),
+                                 (prev.get("errors") or {}).get(c, 0))
+                           for c in _CLASSES},
+                "slow": {c: _d((raw.get("slow") or {}).get(c, 0),
+                               (prev.get("slow") or {}).get(c, 0))
                          for c in _CLASSES},
                 "inflight": {c: raw["inflight"].get(c, 0)
                              for c in _CLASSES},
                 "queueDepth": raw["queueDepth"],
-                "rx": self._delta(raw["rx"], prev["rx"]),
-                "tx": self._delta(raw["tx"], prev["tx"]),
+                "rx": _d(raw["rx"], prev["rx"]),
+                "tx": _d(raw["tx"], prev["tx"]),
                 "kernelBytes": {
-                    b: self._delta(v, prev["kernelBytes"].get(b, 0))
+                    b: _d(v, prev["kernelBytes"].get(b, 0))
                     for b, v in raw["kernelBytes"].items()},
-                "hedgeFired": self._delta(raw["hedgeFired"],
-                                          prev["hedgeFired"]),
+                "hedgeFired": _d(raw["hedgeFired"],
+                                 prev["hedgeFired"]),
                 # Cache row (hot-object serving tier): hit/miss/fill
                 # deltas + resident bytes, rendered by mtpu_top.
-                "cacheHits": self._delta(raw.get("cacheHits", 0),
-                                         prev.get("cacheHits", 0)),
-                "cacheMisses": self._delta(raw.get("cacheMisses", 0),
-                                           prev.get("cacheMisses", 0)),
-                "cacheFills": self._delta(raw.get("cacheFills", 0),
-                                          prev.get("cacheFills", 0)),
+                "cacheHits": _d(raw.get("cacheHits", 0),
+                                prev.get("cacheHits", 0)),
+                "cacheMisses": _d(raw.get("cacheMisses", 0),
+                                  prev.get("cacheMisses", 0)),
+                "cacheFills": _d(raw.get("cacheFills", 0),
+                                 prev.get("cacheFills", 0)),
                 "cacheBytes": raw.get("cacheBytes", 0),
                 "mrfDepth": raw["mrfDepth"],
                 "drives": dict(raw["drives"]),
                 "backendState": dict(raw["backendState"]),
+                # Alert census at sample time (the watchdog evaluates
+                # AFTER each tick, so this reflects the previous
+                # evaluation — one period of honest lag).
+                "alerts": dict(raw.get("alerts")
+                               or {"firing": 0, "pending": 0,
+                                   "worst": ""}),
                 "nodes": 1,
             }
+            sample["resets"] = resets
             sample["kernelGiBs"] = {
                 b: round(v / dt / (1 << 30), 6)
                 for b, v in sample["kernelBytes"].items()}
@@ -354,22 +397,26 @@ def _collapse_node(snap: dict, period_s: float) -> list[dict]:
         last = group[-1]
         c: dict = {
             "t": key, "nodes": 1,
-            "qps": {}, "shed": {}, "kernelBytes": {},
+            "qps": {}, "shed": {}, "errors": {}, "slow": {},
+            "kernelBytes": {},
             "inflight": dict(last.get("inflight") or {}),
             "queueDepth": last.get("queueDepth", 0),
-            "rx": 0, "tx": 0, "hedgeFired": 0,
+            "rx": 0, "tx": 0, "hedgeFired": 0, "resets": 0,
             "cacheHits": 0, "cacheMisses": 0, "cacheFills": 0,
             "cacheBytes": last.get("cacheBytes", 0),
             "mrfDepth": last.get("mrfDepth", 0),
             "drives": dict(last.get("drives") or {}),
+            # Census, not a counter: the node's LATEST alert state.
+            "alerts": dict(last.get("alerts") or {}),
             "backendState": {},
         }
         for s in group:
-            for fld in ("qps", "shed", "kernelBytes"):
+            for fld in ("qps", "shed", "errors", "slow",
+                        "kernelBytes"):
                 for k, v in (s.get(fld) or {}).items():
                     c[fld][k] = c[fld].get(k, 0) + v
             for fld in ("rx", "tx", "hedgeFired", "cacheHits",
-                        "cacheMisses", "cacheFills"):
+                        "cacheMisses", "cacheFills", "resets"):
                 c[fld] += s.get(fld, 0)
             for k, v in (s.get("backendState") or {}).items():
                 c["backendState"][k] = max(c["backendState"].get(k, 0),
@@ -410,27 +457,39 @@ def merge_timelines(snapshots: list[dict],
             if cur is None:
                 cur = buckets[key] = {
                     "t": key, "nodes": 0,
-                    "qps": {}, "shed": {}, "inflight": {},
+                    "qps": {}, "shed": {}, "errors": {}, "slow": {},
+                    "inflight": {},
                     "queueDepth": 0, "rx": 0, "tx": 0,
                     "kernelBytes": {}, "kernelGiBs": {},
-                    "hedgeFired": 0, "mrfDepth": 0,
+                    "hedgeFired": 0, "mrfDepth": 0, "resets": 0,
                     "cacheHits": 0, "cacheMisses": 0,
                     "cacheFills": 0, "cacheBytes": 0,
                     "drives": {"suspect": 0, "faulty": 0,
                                "quarantined": 0},
+                    "alerts": {"firing": 0, "pending": 0,
+                               "worst": ""},
                     "backendState": {},
                 }
             cur["nodes"] += int(s.get("nodes", 1))
-            for fld in ("qps", "shed", "inflight", "kernelBytes",
-                        "kernelGiBs"):
+            for fld in ("qps", "shed", "errors", "slow", "inflight",
+                        "kernelBytes", "kernelGiBs"):
                 for k, v in (s.get(fld) or {}).items():
                     cur[fld][k] = cur[fld].get(k, 0) + v
             for fld in ("queueDepth", "rx", "tx", "hedgeFired",
                         "mrfDepth", "cacheHits", "cacheMisses",
-                        "cacheFills", "cacheBytes"):
+                        "cacheFills", "cacheBytes", "resets"):
                 cur[fld] += s.get(fld, 0)
             for k, v in (s.get("drives") or {}).items():
                 cur["drives"][k] = cur["drives"].get(k, 0) + v
+            al = s.get("alerts") or {}
+            cal = cur["alerts"]
+            cal["firing"] += al.get("firing", 0)
+            cal["pending"] += al.get("pending", 0)
+            # Worst rule: keep the first firing node's headline (any
+            # one is a valid entry point into /v2/alerts/cluster).
+            if al.get("worst") and (not cal["worst"]
+                                    or al.get("firing", 0) > 0):
+                cal["worst"] = al["worst"]
             for k, v in (s.get("backendState") or {}).items():
                 cur["backendState"][k] = max(
                     cur["backendState"].get(k, 0), v)

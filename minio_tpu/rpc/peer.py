@@ -156,6 +156,14 @@ class PeerRPCService:
             n = max(1, min(int(args["n"]), 36000))
         return ({"timeline": TIMELINE.snapshot(n=n)}, b"")
 
+    def rpc_alerts(self, args: dict, payload: bytes):
+        """This node's watchdog alert census for the cluster alerts
+        endpoint's fan-in merge (obs/watchdog.py merge_alerts — worst
+        state per rule with honest node counts).  Needs no server
+        binding: the watchdog is process-wide."""
+        from ..obs.watchdog import WATCHDOG
+        return ({"alerts": WATCHDOG.snapshot()}, b"")
+
     def rpc_server_info(self, args: dict, payload: bytes):
         srv = self._server()
         return ({"version": __version__,
@@ -395,6 +403,13 @@ class NotificationSys:
         args: dict = {} if n is None else {"n": n}
         return {k: (v if isinstance(v, dict) else {"error": str(v)})
                 for k, v in self._fanout("timeline", args).items()}
+
+    def alerts_all(self) -> dict:
+        """Per-peer watchdog snapshots for the cluster alerts merge
+        (unreachable peers degrade to an error entry — the endpoint
+        counts them as unreachable, never as alert-free)."""
+        return {k: (v if isinstance(v, dict) else {"error": str(v)})
+                for k, v in self._fanout("alerts", {}).items()}
 
     def server_info_all(self) -> dict:
         return {k: (v if isinstance(v, dict) else {"error": str(v)})

@@ -655,6 +655,19 @@ class AdminHandlers:
         out.update(KERNPROF.snapshot())
         return out
 
+    def h_incidents(self, p, body):
+        """Incident bundles (obs/incidents.py): auto-frozen diagnosis
+        state for every alert that reached firing.  Bare GET lists the
+        ring (id + headline); ``?id=`` fetches one full JSON bundle —
+        timeline window, slowlog entries + worst span tree, drive/MRF/
+        backend census, fault plan, effective (redacted) config.
+        Root-only, so drive endpoints stay un-redacted here."""
+        from ..obs.incidents import INCIDENTS
+        if p.get("id"):
+            return INCIDENTS.get(p["id"])  # KeyError -> 404
+        return {"incidents": INCIDENTS.list(),
+                "captured": INCIDENTS.captured_total}
+
     def h_drive_health(self, p, body):
         """Admin view of the drive-health monitor (same shape as the
         unauthenticated /minio-tpu/v2/health/drives node endpoint, but

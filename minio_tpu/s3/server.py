@@ -2258,6 +2258,71 @@ class S3Server:
                         raise ValueError(
                             f"obs {key}={v!r}: must be a positive "
                             "duration like 1s / 500ms / 15m")
+        if subsys == "logger":
+            if kvs.get("json") not in (None, "on", "off"):
+                raise ValueError(
+                    f"logger json={kvs.get('json')!r}: must be on/off")
+        if subsys == "alerts":
+            from ..obs.watchdog import validate_user_rules
+            from ..qos.deadline import parse_duration
+            for key, v in kvs.items():
+                if key == "enable":
+                    if v not in ("on", "off"):
+                        raise ValueError(
+                            f"alerts enable={v!r}: must be on/off")
+                elif key in ("fast_window", "slow_window"):
+                    try:
+                        if parse_duration(v) <= 0:
+                            raise ValueError
+                    except ValueError:
+                        raise ValueError(
+                            f"alerts {key}={v!r}: must be a positive "
+                            "duration like 30s / 1m / 15m")
+                elif key == "burn_threshold":
+                    try:
+                        if not 0 < float(v) <= 1:
+                            raise ValueError
+                    except ValueError:
+                        raise ValueError(
+                            f"alerts burn_threshold={v!r}: must be a "
+                            "fraction in (0, 1]")
+                elif key in ("pending_ticks", "resolve_ticks"):
+                    try:
+                        if int(v) < 1:
+                            raise ValueError
+                    except ValueError:
+                        raise ValueError(
+                            f"alerts {key}={v!r}: must be an integer "
+                            ">= 1")
+                elif key == "rules" and v.strip():
+                    validate_user_rules(v)  # AlertRuleError = ValueError
+                elif key == "webhook_endpoint" and v.strip():
+                    from urllib.parse import urlparse
+                    if urlparse(v).scheme not in ("http", "https"):
+                        raise ValueError(
+                            f"alerts webhook_endpoint={v!r} must be "
+                            "http(s)")
+            # Cross-key: the two-window semantic (fast reacts, slow
+            # confirms) degenerates if fast >= slow — configure()
+            # would silently clamp, so reject the write instead. The
+            # half not in this write reads its current effective
+            # value.
+            if "fast_window" in kvs or "slow_window" in kvs:
+                try:
+                    fast = parse_duration(
+                        kvs.get("fast_window")
+                        or self.config.get("alerts", "fast_window"))
+                    slow = parse_duration(
+                        kvs.get("slow_window")
+                        or self.config.get("alerts", "slow_window"))
+                except ValueError:
+                    fast = slow = 0.0  # per-key checks already raised
+                if fast and slow and fast > slow:
+                    raise ValueError(
+                        f"alerts fast_window ({fast:g}s) must be <= "
+                        f"slow_window ({slow:g}s) — both windows must "
+                        "breach for a burn alert, so a fast window "
+                        "wider than the slow one would never confirm")
         if subsys == "cache":
             from ..qos.deadline import parse_duration
             for key, v in kvs.items():
@@ -2471,6 +2536,47 @@ class S3Server:
             Logger.get().log_once(
                 f"obs timeline config invalid, keeping previous: {e}",
                 "config")
+        # Watchdog alert engine: windows/threshold/hysteresis/user
+        # rules/webhook all reload live (an operator tuning an alert
+        # storm must not need a restart). Applied only when the
+        # EFFECTIVE alerts config changed — the apply hook runs on
+        # every config write, and rebuilding the rule set resets a
+        # rate-mode user rule's delta window (a firing alert would
+        # falsely resolve whenever an operator tunes an UNRELATED
+        # key mid-incident; same convention as fault_inject below).
+        from ..obs.watchdog import WATCHDOG, validate_user_rules
+        acfg = tuple(cfg.get("alerts", k) for k in
+                     ("enable", "fast_window", "slow_window",
+                      "burn_threshold", "pending_ticks",
+                      "resolve_ticks", "rules", "webhook_endpoint",
+                      "webhook_auth_token"))
+        if acfg != getattr(self, "_last_alerts_cfg", None):
+            try:
+                _rules_raw = acfg[6].strip()
+                WATCHDOG.configure(
+                    enable=acfg[0] == "on",
+                    fast_s=parse_duration(acfg[1]),
+                    slow_s=parse_duration(acfg[2]),
+                    burn_threshold=float(acfg[3]),
+                    pending_ticks=int(acfg[4]),
+                    resolve_ticks=int(acfg[5]),
+                    user_rules=(validate_user_rules(_rules_raw)
+                                if _rules_raw else ()),
+                    webhook_endpoint=acfg[7].strip(),
+                    webhook_auth_token=acfg[8])
+                self._last_alerts_cfg = acfg
+            except ValueError as e:  # env override may carry garbage
+                from ..logger import Logger
+                Logger.get().log_once(
+                    f"alerts config invalid, keeping previous: {e}",
+                    "config")
+        # Structured JSON log mode; the legacy MINIO_LOG_JSON env
+        # spelling wins over config (env-first, like every subsystem).
+        import os as _os_log
+        if not _os_log.environ.get("MINIO_LOG_JSON", ""):
+            from ..logger import Logger
+            Logger.get().json_output = \
+                cfg.get("logger", "json") == "on"
         ep = cfg.get("audit_webhook", "endpoint")
         tok = cfg.get("audit_webhook", "auth_token")
         if cfg.get("audit_webhook", "enable") == "on" and ep:
@@ -2959,6 +3065,15 @@ class S3Server:
             except ValueError:
                 return 400, "text/plain", b"bad n/since"
             return self._timeline_cluster(n=n, since=since)
+        if raw_path == "/minio-tpu/v2/alerts":
+            # Node alert census (obs/watchdog.py): active + recently
+            # resolved alerts with causes. Unauthenticated like the
+            # metrics pages — drive identities in causes are redacted.
+            from ..obs.watchdog import WATCHDOG
+            return (200, "application/json",
+                    _json.dumps(WATCHDOG.snapshot()).encode())
+        if raw_path == "/minio-tpu/v2/alerts/cluster":
+            return self._alerts_cluster()
         if raw_path in ("/minio-tpu/console", "/minio-tpu/console/") \
                 and method == "GET":
             from .console import console_response
@@ -3126,6 +3241,41 @@ class S3Server:
                                            build)
         return 200, "application/json", body
 
+    _cluster_alerts_cache: tuple[float, bytes] | None = None
+
+    def _alerts_cluster(self) -> tuple[int, str, bytes]:
+        """Cluster alert census: this node's watchdog snapshot merged
+        with every peer's (scraped over the `alerts` peer RPC) —
+        worst state per rule, count of nodes firing it, and an HONEST
+        node count: unreachable peers are reported as such instead of
+        silently reading as alert-free (same TTL-cached fan-in shape
+        as metrics2/drives/timeline)."""
+        import json as _json
+        from ..obs.watchdog import WATCHDOG, merge_alerts
+
+        def build() -> bytes:
+            named = [("local", WATCHDOG.snapshot())]
+            unreachable = 0
+            if self.notification is not None:
+                for i, (key, res) in enumerate(
+                        sorted(self.notification.alerts_all()
+                               .items())):
+                    snap = res.get("alerts") if isinstance(res, dict) \
+                        else None
+                    if isinstance(snap, dict):
+                        # Anonymous surface: a stable ordinal, not the
+                        # peer's internal host:port.
+                        named.append((f"peer{i}", snap))
+                    else:
+                        unreachable += 1
+            doc = merge_alerts(named)
+            doc["unreachable"] = unreachable
+            return _json.dumps(doc).encode()
+
+        body = self._cached_cluster_scrape("_cluster_alerts_cache",
+                                           build)
+        return 200, "application/json", body
+
     @staticmethod
     def _parse_n_since(params: dict) -> tuple[int | None, float | None]:
         """The timeline endpoints' shared ?n=/?since= parse (raises
@@ -3169,6 +3319,15 @@ class S3Server:
                                               n=n, since=since)
             body = _json.dumps(doc).encode()
         return 200, "application/json", body
+
+    def _incident_config(self) -> dict:
+        """Effective config for incident bundles, credentials masked
+        (obs/incidents.py applies the same policy; doubly-redacted is
+        fine, un-redacted is not)."""
+        if self.config is None:
+            return {}
+        from ..obs.incidents import _redact_config
+        return _redact_config(self.config.dump())
 
     def _mrf_stats(self) -> dict:
         """MRF heal-queue census across this node's erasure sets
@@ -3548,6 +3707,19 @@ class S3Server:
                         METRICS2.inc("minio_tpu_v2_api_requests_total",
                                      {"api": api,
                                       "status": resp.status})
+                        if resp.status >= 500 \
+                                and not req.slowlog_exempt:
+                            # Per-CLASS 5xx counter: the watchdog's
+                            # error-burn numerator (api_requests_total
+                            # has per-API status detail but no class).
+                            # Sheds/burnt deadlines are EXEMPT like in
+                            # the slowlog: deliberate backpressure is
+                            # the shed-burn rule's signal, and letting
+                            # it bleed into error-burn would page twice
+                            # for one brownout.
+                            METRICS2.inc(
+                                "minio_tpu_v2_api_class_errors_total",
+                                {"class": req.qos_class or "read"})
                         METRICS2.observe(
                             "minio_tpu_v2_api_request_duration_ms",
                             {"api": api}, dur_ms)
@@ -3747,6 +3919,12 @@ class S3Server:
         from ..obs.timeline import TIMELINE
         TIMELINE.start()
         self._timeline_started = True
+        # Incident bundles capture server-scoped context (effective
+        # config, MRF census) through providers — the recorder itself
+        # stays server-agnostic.
+        from ..obs.incidents import INCIDENTS
+        INCIDENTS.providers["config"] = self._incident_config
+        INCIDENTS.providers["mrf"] = self._mrf_stats
         if cert_manager is not None:
             cert_manager.start()
         # mtpu-lint: disable=R1 -- the accept loop itself; request context is OPENED per request below it
@@ -3768,6 +3946,16 @@ class S3Server:
             self._timeline_started = False
             from ..obs.timeline import TIMELINE
             TIMELINE.stop()
+            # Unregister OUR incident providers (another server may
+            # have installed its own since): bound methods would
+            # otherwise pin this server's whole object graph for the
+            # process lifetime and report a dead server's config in
+            # bundles captured after the stop.
+            from ..obs.incidents import INCIDENTS
+            for key, fn in (("config", self._incident_config),
+                            ("mrf", self._mrf_stats)):
+                if INCIDENTS.providers.get(key) == fn:
+                    del INCIDENTS.providers[key]
         if getattr(self, "cert_manager", None) is not None:
             self.cert_manager.stop()
         if self._httpd:

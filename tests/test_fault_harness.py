@@ -700,5 +700,11 @@ def test_slow_disk_flagged_suspect_and_put_blamed_disk(tmp_path):
         FAULTS.clear()
         srv.stop()
         SLOWLOG.configure(1000.0, {}, False)
+        # The injected suspect is process-global state: left in place
+        # it keeps the watchdog's census-based drive_degraded built-in
+        # (default-on since PR 9) firing through every LATER module's
+        # servers — the census is a consumed signal now, not just a
+        # report.
+        DRIVEMON.reset()
 
 

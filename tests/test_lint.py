@@ -29,7 +29,8 @@ from tools.mtpu_lint.rules.kernels import KernelPurityRule
 from tools.mtpu_lint.rules.locks import BlockingUnderLockRule
 from tools.mtpu_lint.rules.obs import (KernprofTimelineMetricCallRule,
                                        MetricNameRule, NativeAssertRule,
-                                       QosMetricCallRule)
+                                       QosMetricCallRule,
+                                       WatchdogIncidentMetricCallRule)
 from tools.mtpu_lint.rules.resources import ResourceLeakRule
 from tools.mtpu_lint.rules.retries import BoundedRetryRule
 
@@ -488,6 +489,32 @@ def test_o6_kernprof_timeline_literal_recording_calls():
     # Out of scope: the rule does not apply elsewhere in obs/.
     assert not KernprofTimelineMetricCallRule().applies(
         _ctx(bad, "minio_tpu/obs/metrics2.py"))
+
+
+def test_o7_watchdog_incidents_literal_recording_calls():
+    # POSITIVE: dynamic name + unregistered literal, in both scoped
+    # files of the watchdog/incidents family.
+    bad = ("def f(name):\n"
+           "    METRICS2.inc(name)\n"
+           "    METRICS2.set_gauge('minio_tpu_v2_not_a_real_series',"
+           " {'rule': 'shed_burn'}, 1)\n")
+    for path in ("minio_tpu/obs/watchdog.py",
+                 "minio_tpu/obs/incidents.py"):
+        assert len(_check(WatchdogIncidentMetricCallRule(), bad,
+                          path)) == 2
+    # NEGATIVE: literal registered names are clean.
+    good = ("def f():\n"
+            "    METRICS2.set_gauge('minio_tpu_v2_alerts_firing',"
+            " {'rule': 'shed_burn'}, 1)\n"
+            "    METRICS2.inc('minio_tpu_v2_incidents_total',"
+            " {'rule': 'shed_burn'})\n"
+            "    METRICS2.inc('minio_tpu_v2_alert_webhook_total',"
+            " {'result': 'sent'})\n")
+    assert _check(WatchdogIncidentMetricCallRule(), good,
+                  "minio_tpu/obs/watchdog.py") == []
+    # Out of scope: the rule does not apply elsewhere in obs/.
+    assert not WatchdogIncidentMetricCallRule().applies(
+        _ctx(bad, "minio_tpu/obs/slowlog.py"))
 
 
 # ---------------------------------------------------------------------------

@@ -24,11 +24,19 @@ ACCESS, SECRET = "tladmin", "tladmin-secret"
 
 @pytest.fixture(autouse=True)
 def _clean_state():
+    # The watchdog resets too: the backend-flip test deliberately
+    # takes a backend DOWN, which (correctly) fires the
+    # kernel_backend_down alert — state left mid-resolve would make
+    # mtpu_top --once exit 2 in a later test (that exit code is the
+    # feature; the leak across tests is not).
+    from minio_tpu.obs.watchdog import WATCHDOG
     KERNPROF.reset()
     FAULTS.clear()
+    WATCHDOG.reset()
     yield
     KERNPROF.reset()
     FAULTS.clear()
+    WATCHDOG.reset()
 
 
 class _ScriptedTimeline(Timeline):
@@ -300,8 +308,22 @@ def test_node_endpoint_serves_samples_with_traffic(server):
         assert field in s, field
     assert set(s["backendState"]) == {"device", "native", "xla-cpu",
                                       "host"}
-    # PUT traffic moved kernel bytes on some host-side backend.
-    assert any(sum(x["kernelBytes"].values()) > 0 for x in samples)
+    # PUT traffic moved kernel bytes on some host-side backend. The
+    # qps count lands at ADMISSION time, the encode bytes at dispatch
+    # a few ms later — under full-suite CPU starvation those can fall
+    # in adjacent 50ms windows, so poll past the already-fetched doc
+    # (with traffic still flowing) instead of asserting on it.
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if any(sum((x.get("kernelBytes") or {}).values()) > 0
+               for x in samples):
+            break
+        assert c.put_object("tlb", f"kb-{i}", body).status == 200
+        i += 1
+        time.sleep(0.05)
+        samples = _get_json(port, "/minio-tpu/v2/timeline")["samples"]
+    assert any(sum((x.get("kernelBytes") or {}).values()) > 0
+               for x in samples), samples[-3:]
     # The worst-request exemplar links to a real trace id. It lands in
     # the window where the request FINISHES (qps counts admission), so
     # under load it can trail the busy window by a tick — poll for it.
@@ -438,14 +460,30 @@ def test_mtpu_top_once_against_live_server(server, capsys):
     renders the load-bearing rows from a live node endpoint."""
     from tools import mtpu_top
     srv, port = server
+    # Samples stamped while an earlier test's alert was firing may
+    # still be the ring's NEWEST for a tick or two after the autouse
+    # watchdog reset — wait for a post-reset sample (firing=0), since
+    # a nonzero exit on a firing alert is mtpu_top's contract.
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        doc = _get_json(port, "/minio-tpu/v2/timeline?n=1")
+        if doc["samples"] and not (doc["samples"][-1].get("alerts")
+                                   or {}).get("firing", 0):
+            break
+        time.sleep(0.05)
     rc = mtpu_top.main(["--url", f"http://127.0.0.1:{port}", "--once",
                         "--n", "50"])
     out = capsys.readouterr().out
     assert rc == 0
     assert "minio-tpu top" in out
     assert "kernel:" in out
+    assert "alerts:" in out
     assert "drives:" in out and "qps" in out
-    # Cluster mode rides the same renderer.
+    # Cluster mode rides the same renderer. Drop the TTL-cached merge
+    # first: a cluster doc built up to 10s ago (by an earlier test,
+    # while an alert from that test was still firing) would make the
+    # exit-2-on-firing contract trip on STALE state.
+    srv._cluster_timeline_cache = None
     rc = mtpu_top.main(["--url", f"http://127.0.0.1:{port}", "--once",
                         "--cluster"])
     assert rc == 0

@@ -9,7 +9,7 @@ from .locks import BlockingUnderLockRule
 from .obs import (DrivemonSlowlogMetricCallRule,
                   KernprofTimelineMetricCallRule, MetricNameRule,
                   NativeAssertRule, PipelineMetricCallRule,
-                  QosMetricCallRule)
+                  QosMetricCallRule, WatchdogIncidentMetricCallRule)
 from .resources import ResourceLeakRule
 from .retries import BoundedRetryRule
 
@@ -28,4 +28,5 @@ def all_rules():
         PipelineMetricCallRule(),
         DrivemonSlowlogMetricCallRule(),
         KernprofTimelineMetricCallRule(),
+        WatchdogIncidentMetricCallRule(),
     ]

@@ -10,6 +10,7 @@ O3  qos/ recording calls pass literal registered names
 O4  utils/pipeline.py recording calls pass literal registered names
 O5  obs/drivemon.py + obs/slowlog.py recording calls likewise
 O6  obs/kernprof.py + obs/timeline.py recording calls likewise
+O7  obs/watchdog.py + obs/incidents.py recording calls likewise
 """
 
 from __future__ import annotations
@@ -136,3 +137,10 @@ class KernprofTimelineMetricCallRule(_LiteralCallRule):
     title = "kernprof/timeline metric recordings use literal registered names"
     what = "kernprof/timeline"
     paths = ("minio_tpu/obs/kernprof.py", "minio_tpu/obs/timeline.py")
+
+
+class WatchdogIncidentMetricCallRule(_LiteralCallRule):
+    id = "O7"
+    title = "watchdog/incident metric recordings use literal registered names"
+    what = "watchdog/incidents"
+    paths = ("minio_tpu/obs/watchdog.py", "minio_tpu/obs/incidents.py")

@@ -10,7 +10,10 @@ samples in-process (obs/timeline.py), so no scraper setup is needed.
 
 ``--once`` prints a single snapshot and exits 0 — no TTY, no clearing
 — which is how tier-1 exercises this tool against a live test server
-so the console view can't rot (tests/test_timeline.py).
+so the console view can't rot (tests/test_timeline.py).  When any
+watchdog alert is FIRING, ``--once`` exits 2 (the alerts row shows
+firing/pending counts + the worst rule), so CI and the fault harness
+can use it as a one-shot health probe.
 
 Usage:
     python -m tools.mtpu_top --url http://127.0.0.1:9000 [--cluster]
@@ -52,6 +55,14 @@ def sparkline(values: list[float], width: int) -> str:
     return "".join(
         SPARK[min(len(SPARK) - 1, int(v / top * (len(SPARK) - 1)))]
         for v in vals)
+
+
+def firing_count(doc: dict) -> int:
+    """Firing alerts in the newest sample (node or cluster-merged)."""
+    samples = doc.get("samples", [])
+    if not samples:
+        return 0
+    return int((samples[-1].get("alerts") or {}).get("firing", 0))
 
 
 def _num(v: float) -> str:
@@ -121,6 +132,15 @@ def render(doc: dict, width: int = 60) -> str:
                  f"quarantined={d.get('quarantined', 0)}   "
                  f"mrf depth={_num(last.get('mrfDepth', 0))}   "
                  f"hedges/s={_num(last.get('hedgeFired', 0) / dt(last))}")
+    # Watchdog row: active alert census (samples carry it per node and
+    # the cluster merge sums it). --once exits nonzero on any firing
+    # alert, so CI and the fault harness can assert on this row.
+    al = last.get("alerts") or {}
+    lines.append(f"alerts: firing={_num(al.get('firing', 0))} "
+                 f"pending={_num(al.get('pending', 0))}"
+                 + (f"   worst={al['worst']}"
+                    "  (admin /incidents has the bundle)"
+                    if al.get("worst") else ""))
 
     qps_hist = [sum((s.get("qps") or {}).values()) / dt(s)
                 for s in samples]
@@ -164,12 +184,16 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.once:
         try:
-            print(frame())
+            doc = fetch_timeline(args.url, cluster=args.cluster,
+                                 n=args.n, timeout=args.timeout)
         except (urllib.error.URLError, OSError, ValueError) as exc:
             print(f"mtpu_top: cannot read timeline at {args.url}: "
                   f"{exc}", file=sys.stderr)
             return 1
-        return 0
+        print(render(doc, width=args.width))
+        # Exit 2 when any alert is firing: `mtpu_top --once` becomes
+        # an assertable health probe for CI and the fault harness.
+        return 2 if firing_count(doc) else 0
 
     try:
         while True:
