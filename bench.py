@@ -905,6 +905,170 @@ def bench_hot_get(np, workdir: str) -> dict:
             shutil.rmtree(base, ignore_errors=True)
 
 
+# --- config 9: crash recovery — kill -9 mid-PUT-loop, restart, recover -------
+
+
+def bench_crash_recovery(np, workdir: str) -> dict:
+    """PR-11 acceptance: a real `python -m minio_tpu server` is
+    SIGKILL-ed mid-PUT-loop and restarted on the same disks; report
+    (a) time-to-first-served-request after the restart exec, (b) the
+    boot recovery sweep's duration + census, and (c) the `storage
+    fsync=on` commit-path overhead as PAIRED on/off put_p50 deltas
+    (PR-4's method — this VM drifts +/-20% on second timescales, so
+    only paired deltas survive the noise)."""
+    import signal
+    import socket
+    import subprocess
+    import sys as _sys
+
+    from minio_tpu.s3.admin_client import AdminClient
+    from minio_tpu.s3.client import S3Client
+
+    access, secret = "benchadmin", "benchadmin-secret"
+    # Deliberately DISK-backed (unlike the other configs' tmpfs):
+    # crash recovery is about durable media, and `fsync=on` measured
+    # on tmpfs reads ~0 — the number would flatter the knob.
+    root = tempfile.mkdtemp(prefix="minio-tpu-crash-")
+    disks = [os.path.join(root, f"d{i}") for i in range(1, 7)]
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ, MINIO_ACCESS_KEY=access,
+               MINIO_SECRET_KEY=secret, JAX_PLATFORMS="cpu",
+               MINIO_RECOVERY_TMP_AGE="1",
+               MINIO_CRAWLER_INTERVAL="3600",
+               MINIO_HEAL_NEWDISK_INTERVAL="3600")
+    log_path = os.path.join(root, "node.log")
+    os.makedirs(root, exist_ok=True)
+
+    def boot():
+        log = open(log_path, "ab")
+        p = subprocess.Popen(
+            [_sys.executable, "-m", "minio_tpu", "server", *disks,
+             "--address", f"127.0.0.1:{port}"],
+            stdout=log, stderr=subprocess.STDOUT, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        log.close()
+        return p
+
+    def wait_serving(client, key, want, timeout=90.0):
+        t0 = time.perf_counter()
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                g = client.get_object("bench", key)
+                if g.status == 200 and g.body == want:
+                    return time.perf_counter() - t0
+            except Exception:
+                pass
+            time.sleep(0.02)
+        raise RuntimeError("restarted server never served")
+
+    client = S3Client("127.0.0.1", port, access, secret)
+    adm = AdminClient("127.0.0.1", port, access, secret)
+    rng = np.random.default_rng(11)
+    body = rng.integers(0, 256, 256 * 1024).astype(np.uint8).tobytes()
+    proc = boot()
+    try:
+        wait_serving_boot = time.time() + 90
+        while time.time() < wait_serving_boot:
+            try:
+                if client.make_bucket("bench").status in (200, 409):
+                    break
+            except Exception:
+                pass
+            time.sleep(0.1)  # every retry backs off, not just refusals
+        client.put_object("bench", "anchor", body)
+
+        # Kill -9 mid-PUT-loop: the loop runs in its own thread so the
+        # SIGKILL lands while a PUT is actually in flight on the
+        # commit path (a synchronous loop is ~always between requests
+        # at these object sizes).
+        counted = [0]
+        halt = threading.Event()
+
+        def put_loop():
+            put_client = S3Client("127.0.0.1", port, access, secret)
+            while not halt.is_set():
+                try:
+                    put_client.put_object(
+                        "bench", f"k-{counted[0]}", body)
+                    counted[0] += 1
+                except Exception:
+                    return  # the kill landed mid-request
+        # mtpu-lint: disable=R1 -- bench driver thread, no request context to carry
+        putter = threading.Thread(target=put_loop, daemon=True)
+        putter.start()
+        deadline = time.time() + 30
+        while time.time() < deadline and counted[0] < 20:
+            time.sleep(0.01)
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+        halt.set()
+        putter.join(timeout=10)
+        killed_after = counted[0]
+        time.sleep(1.2)  # orphans must clear the 1s recovery age gate
+
+        t_restart = time.perf_counter()
+        proc = boot()
+        wait_serving(client, "anchor", body)
+        ttfs_s = time.perf_counter() - t_restart
+        rep = adm.recovery()
+        sweep_ms = sum(s_.get("durationS", 0.0)
+                       for s_ in rep["sweeps"]) * 1e3
+        census = {k: sum(s_.get(k, 0) for s_ in rep["sweeps"])
+                  for k in ("found", "cleaned", "stageFiles",
+                            "journalReplayed")}
+        census["requeued"] = sum(len(s_.get("requeued", []))
+                                 for s_ in rep["sweeps"])
+
+        # Paired fsync on/off PUT p50 (the toggle is one config write,
+        # applied live through storage/xl.py set_fsync).
+        lat_on: list = []
+        lat_off: list = []
+        for i in range(24):
+            order = (True, False) if i % 2 == 0 else (False, True)
+            for on in order:
+                adm.set_config_kv(
+                    f"storage fsync={'on' if on else 'off'}")
+                t0 = time.perf_counter()
+                r = client.put_object("bench", f"fs-{i}-{int(on)}",
+                                      body)
+                dt = time.perf_counter() - t0
+                if r.status != 200:
+                    raise RuntimeError(f"fsync PUT failed: {r.status}")
+                (lat_on if on else lat_off).append(dt)
+        adm.set_config_kv("storage fsync=off")
+        p50_on = statistics.median(lat_on) * 1e3
+        p50_off = statistics.median(lat_off) * 1e3
+        delta = statistics.median(
+            [(a - b) * 1e3 for a, b in zip(lat_on, lat_off)])
+        return {
+            "metric": "crash_recovery_time_to_first_served",
+            "value": round(ttfs_s * 1e3, 1), "unit": "ms",
+            "kill_after_puts": killed_after,
+            "object_bytes": len(body),
+            "workdir": "disk",
+            "recovery_sweep_ms": round(sweep_ms, 2),
+            "recovery_census": census,
+            # storage fsync=on paired overhead (default-off ships; the
+            # knob buys power-cut durability at this measured cost).
+            "fsync_on_put_p50_ms": round(p50_on, 3),
+            "fsync_off_put_p50_ms": round(p50_off, 3),
+            "fsync_overhead_pct": round(
+                delta / max(p50_off, 1e-9) * 100.0, 2),
+        }
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGKILL)
+            try:
+                proc.wait(timeout=10)
+            except Exception:
+                pass
+        shutil.rmtree(root, ignore_errors=True)
+
+
 class _DeviceHunt(threading.Thread):
     """Background device acquisition for the WHOLE bench run.
 
@@ -1047,7 +1211,9 @@ def main() -> None:
                      ("qos_brownout",
                       lambda: bench_qos_brownout(np, workdir)),
                      ("hot_get",
-                      lambda: bench_hot_get(np, workdir))):
+                      lambda: bench_hot_get(np, workdir)),
+                     ("crash_recovery",
+                      lambda: bench_crash_recovery(np, workdir))):
         _progress(f"config {name} (host mode)")
         pipe = config_pipeline.get(name)
         factor_box: dict = {}

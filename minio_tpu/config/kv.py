@@ -77,6 +77,15 @@ DEFAULT_KVS: dict[str, dict[str, str]] = {
     "rpc": {
         "offline_retry": "2s",
     },
+    # Commit-path durability (storage/xl.py commit_replace): fsync=on
+    # routes every commit rename through fsync-file + fsync-parent-dir
+    # so a power cut cannot lose an acknowledged write to the page
+    # cache. Default off — the reference's fsync-less reliable-rename
+    # — because the overhead is real (bench.py crash_recovery measures
+    # it paired; docs/robustness.md documents the tradeoff).
+    "storage": {
+        "fsync": "off",
+    },
     # Runtime fault injection (minio_tpu/faultinject): enable=on with
     # a plan (COMPACT JSON — no spaces — or set it via the admin
     # /fault-inject API) loads the deterministic fault plan at apply

@@ -25,10 +25,11 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..faultinject import FAULTS
 from ..parallel.quorum import parallel_map
 from ..storage import errors as serr
 from ..storage.metadata import FileInfo
-from ..storage.xl import MINIO_META_BUCKET, TMP_PATH
+from ..storage.xl import INTENT_FILE, MINIO_META_BUCKET, TMP_PATH
 from ..utils import ceil_frac
 from . import bitrot
 from .codec import Erasure
@@ -36,6 +37,13 @@ from .codec import Erasure
 # Cap on stacked survivor bytes per coalesced heal dispatch: large
 # enough to saturate the device, small enough to bound heal memory.
 HEAL_BATCH_BYTES = 64 * 1024 * 1024
+
+# Crash points on the heal write-back commit: mid shard regeneration
+# (staged frames on the bad disks, object still degraded) and just
+# before the per-disk rename_data fan-out (fully staged).
+CRASH_HEAL_MID = FAULTS.register_crash_point("engine.heal.mid_append")
+CRASH_HEAL_PRE_COMMIT = FAULTS.register_crash_point(
+    "engine.heal.pre_commit")
 
 
 @dataclass
@@ -345,6 +353,19 @@ class Healer:
         # disk from the write set without aborting the others.
         tmp_paths = {i: f"{TMP_PATH}/{uuid.uuid4()}" for i in bad}
         write_errs: dict[int, BaseException] = {}
+        # Recovery breadcrumbs: a crash mid write-back leaves staged
+        # frames on the bad disks; the boot sweep reads the intent to
+        # requeue the (still-degraded) object for heal before GC.
+        from .engine import _stage_intent_blob
+        intent_blob = _stage_intent_blob(bucket, object_name,
+                                         fi.version_id, fi.data_dir)
+        for i in bad:
+            try:
+                eng.disks[i].append_file(
+                    MINIO_META_BUCKET, f"{tmp_paths[i]}/{INTENT_FILE}",
+                    intent_blob)
+            except Exception:
+                pass  # best-effort; a dead disk fails its appends next
 
         def drop_disk(i: int, exc: BaseException) -> None:
             write_errs[i] = exc
@@ -374,6 +395,10 @@ class Healer:
                     live = [i for i in bad if i not in write_errs]
                     if not live:
                         break  # nobody left to heal; stop decoding
+                    # Crash window: fires per block group — staged
+                    # frames on the bad disks, object still serving
+                    # from its k survivors.
+                    FAULTS.crash_point(CRASH_HEAL_MID)
                     _, errs = parallel_map(
                         [lambda i=i: eng.disks[i].append_file(
                             MINIO_META_BUCKET,
@@ -416,6 +441,9 @@ class Healer:
                     pass
                 raise
 
+        # Crash window: every regenerated shard staged, rename_data
+        # fan-out not yet started.
+        FAULTS.crash_point(CRASH_HEAL_PRE_COMMIT)
         alive_bad = [i for i in bad if i not in write_errs]
         _, errs = parallel_map([lambda i=i: commit_one(i)
                                 for i in alive_bad])
@@ -785,7 +813,16 @@ class QuarantineProber:
 class MRFQueue:
     """Most-recently-failed heal queue: partial PUT failures enqueue the
     object for background healing (ref mrfOpCh, cmd/erasure-object.go:1082
-    + healRoutine, cmd/background-heal-ops.go:89)."""
+    + healRoutine, cmd/background-heal-ops.go:89).
+
+    Two robustness layers on top of the reference's buffered channel:
+    (a) ``add()`` DEDUPS — a flapping drive requeueing the same object
+    on every degraded write used to inflate depth and force drops of
+    OTHER objects' repairs; now a (bucket, object) already queued is a
+    set lookup, not a new entry. (b) every accepted entry is journaled
+    to the per-set durable MRF journal (erasure/mrfjournal.py,
+    ``.minio.sys/mrf.log``) and replayed at boot, so a crash no longer
+    silently discards the queued repairs."""
 
     # One drop log line per window — a full queue under a disk outage
     # drops thousands of entries, and each dropped heal is data
@@ -800,19 +837,47 @@ class MRFQueue:
         self._stop = threading.Event()
         self.drops = 0
         self._last_drop_log = 0.0
+        # In-flight dedup set guarded by its own tiny lock (the Queue's
+        # internal mutex is not reachable for the membership check).
+        self._qmu = threading.Lock()
+        self._queued: set[tuple[str, str]] = set()
+        from .mrfjournal import MRFJournal
+        self.journal = MRFJournal(healer.engine.disks)
 
     def depth(self) -> int:
         return self.q.qsize()
 
     def add(self, bucket: str, object_name: str) -> None:
         from ..obs.metrics2 import METRICS2
-        try:
-            self.q.put_nowait((bucket, object_name))
-        except queue.Full:
-            # Best effort like the reference's buffered channel — but
-            # COUNTED: a silent drop is a heal that never happens
-            # until the next full sweep notices.
-            self.drops += 1
+        key = (bucket, object_name)
+        dropped = False
+        # Dedup-insert, enqueue, AND journal under one critical
+        # section, mirrored by _heal's retire path: interleaving them
+        # lets a concurrent retire of the SAME key either dedup a
+        # fresh repair out of existence or strip a freshly queued
+        # repair of its journal entry (crash durability silently
+        # lost). MRF adds are failure-path, never hot, and the
+        # journal batches its I/O — serializing them is cheap.
+        with self._qmu:
+            if key in self._queued:
+                return  # already queued: dedup, don't inflate depth
+            self._queued.add(key)
+            try:
+                self.q.put_nowait((bucket, object_name))
+            except queue.Full:
+                # Best effort like the reference's buffered channel —
+                # but COUNTED: a silent drop is a heal that never
+                # happens until the next full sweep notices.
+                self._queued.discard(key)
+                self.drops += 1
+                dropped = True
+            else:
+                # Durability: journal the accepted entry so a crash
+                # replays it (no-op when already journaled, when the
+                # set has no local disks, or past the size cap —
+                # drops counted there).
+                self.journal.record(bucket, object_name)
+        if dropped:
             METRICS2.inc("minio_tpu_v2_mrf_drops_total")
             now = time.monotonic()
             if now - self._last_drop_log >= self.DROP_LOG_WINDOW_S:
@@ -830,6 +895,17 @@ class MRFQueue:
         # explicit wiring.
         if self._thread is None:
             self.start()
+
+    def replay_journal(self) -> int:
+        """Boot-time replay (storage/recovery.py): re-queue every
+        journaled repair through the normal add() path, so the depth
+        gauge reflects the replayed backlog and the worker starts.
+        Entries already in the journal are not re-appended (replay
+        seeds the journal's dedup set)."""
+        entries = self.journal.replay()
+        for bucket, object_name in entries:
+            self.add(bucket, object_name)
+        return len(entries)
 
     def start(self) -> None:
         if self._thread is not None:
@@ -868,11 +944,21 @@ class MRFQueue:
         from ..qos.scheduler import GATE, background_lane
         bucket, object_name, tries = (item if len(item) == 3
                                       else (*item, 0))
+        requeued = False
+        converged = False
         try:
             with background_lane():
                 GATE.throttle_background()  # MRF drains behind traffic
-            self.healer.heal_object(bucket, object_name,
-                                    lock_timeout=self.LOCK_WAIT_S)
+            res = self.healer.heal_object(bucket, object_name,
+                                          lock_timeout=self.LOCK_WAIT_S)
+            # Converged: every bad disk healed (or nothing was bad, or
+            # the object is dangling/deleted — no future heal will
+            # change it). Only then does the JOURNAL entry retire; a
+            # failed heal keeps its durability debt on disk for the
+            # next boot/retry.
+            bad = set(res.corrupt_disks) | set(res.missing_disks)
+            converged = (res.dangling
+                         or bad <= set(res.healed_disks))
         except TimeoutError:
             # Still contended: requeue to the BACK with a retry cap —
             # the sweep loops that enqueued this expect an eventual
@@ -880,10 +966,22 @@ class MRFQueue:
             if tries + 1 < self.MAX_TRIES:
                 try:
                     self.q.put_nowait((bucket, object_name, tries + 1))
+                    requeued = True
                 except queue.Full:
                     pass
         except Exception:
             pass  # background best-effort
+        finally:
+            if not requeued:
+                # Retire under the same lock add() inserts under (see
+                # add): the key leaves the dedup set either way — a
+                # FAILED heal must be re-addable by the next degraded
+                # write or sweep — and a CONVERGED heal retires its
+                # journal entry atomically with it.
+                with self._qmu:
+                    self._queued.discard((bucket, object_name))
+                    if converged:
+                        self.journal.complete(bucket, object_name)
 
     def _run(self) -> None:
         from ..obs.metrics2 import METRICS2

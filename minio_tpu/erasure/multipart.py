@@ -18,17 +18,28 @@ import hashlib
 import json
 import uuid
 
+from ..faultinject import FAULTS
 from ..parallel.quorum import (QuorumError, first_success, hash_order,
                                parallel_map, reduce_quorum_errs,
                                write_quorum)
 from ..storage import errors as serr
 from ..storage.metadata import (ErasureInfo, FileInfo, ObjectPartInfo,
                                 new_data_dir, now)
-from ..storage.xl import MINIO_META_BUCKET, TMP_PATH
+from ..storage.xl import INTENT_FILE, MINIO_META_BUCKET, TMP_PATH
 from . import bitrot
 
 MPU_PATH = "mpu"
 MIN_PART_SIZE = 5 * 1024 * 1024  # S3 minimum for all but the last part
+
+# Crash points on multipart complete — the windows where a process
+# death leaves the upload staged, half-linked, or committed-but-not-
+# garbage-collected (tests/test_crash_consistency.py).
+CRASH_MPU_PRE = FAULTS.register_crash_point(
+    "engine.multipart.pre_commit")
+CRASH_MPU_LINK = FAULTS.register_crash_point(
+    "engine.multipart.mid_link")
+CRASH_MPU_POST = FAULTS.register_crash_point(
+    "engine.multipart.post_commit")
 
 
 class UploadNotFound(Exception):
@@ -318,11 +329,25 @@ class MultipartUploads:
             meta["x-internal-actual-size"] = str(total_actual)
         wq = write_quorum(eng.k, eng.m)
 
+        from .engine import _stage_intent_blob
+        intent_blob = _stage_intent_blob(bucket, object_name, "",
+                                         data_dir)
+
         def commit_one(i: int):
             disk = eng.disks[i]
             tmp_path = f"{TMP_PATH}/{uuid.uuid4()}"
             link = getattr(disk, "link_file", None)
             try:
+                if total_size > 0:
+                    # Recovery breadcrumb before the link/copy loop:
+                    # a crash mid-commit leaves this stage dir for the
+                    # boot sweep to map back to the object.
+                    try:
+                        disk.append_file(MINIO_META_BUCKET,
+                                         f"{tmp_path}/{INTENT_FILE}",
+                                         intent_blob)
+                    except serr.StorageError:
+                        pass
                 # Stage this disk's part shards into the commit data
                 # dir, KEEPING the client's part numbers (SSE derives
                 # per-part keys from them, and ListParts reports them;
@@ -335,6 +360,11 @@ class MultipartUploads:
                 # link support fall back to read+write copy.
                 if total_size > 0:
                     for p in part_infos:
+                        # Crash window: fires per part, so an `after`
+                        # count lands the kill MID hard-link loop —
+                        # some parts staged, some not, nothing
+                        # visible.
+                        FAULTS.crash_point(CRASH_MPU_LINK)
                         if link is not None:
                             try:
                                 link(MINIO_META_BUCKET,
@@ -385,6 +415,9 @@ class MultipartUploads:
                     pass
                 raise
 
+        # Crash window: upload validated, nothing staged into tmp yet
+        # — a death here must leave the upload intact and retryable.
+        FAULTS.crash_point(CRASH_MPU_PRE)
         # Exclusive commit against concurrent put/delete on the same key
         # (ref CompleteMultipartUpload NSLock, cmd/erasure-multipart.go).
         with eng.ns_lock.write_locked(bucket, object_name):
@@ -402,6 +435,11 @@ class MultipartUploads:
                 self._cleanup(bucket, object_name, upload_id)
                 raise
             reduce_quorum_errs(errs, wq, "complete_multipart_upload")
+        # Crash window: the object is quorum-committed but the upload
+        # session (mpu dir) hasn't been reclaimed — a death here must
+        # serve the completed object; the leftover upload stays
+        # abortable/listable (ref stale-upload cleanup).
+        FAULTS.crash_point(CRASH_MPU_POST)
         if any(e is not None for e in errs):
             eng.mrf.add(bucket, object_name)
         self._cleanup(bucket, object_name, upload_id)

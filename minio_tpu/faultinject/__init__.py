@@ -22,11 +22,26 @@ share ONE plan with ONE deterministic decision procedure:
     ]}
 
 Rule fields: ``kind`` (required), ``target`` (substring matched against
-the drive endpoint / peer endpoint / kernel name; empty matches all),
-``op`` (exact storage op name or drivemon op class read/write/stat/
-delete; ``*`` matches all), ``latency_ms``, ``probability`` (default
-1.0), ``after`` (skip the first N matching occurrences), ``count``
-(fire at most N times; 0 = unlimited).
+the drive endpoint / peer endpoint / kernel name / crash-point name;
+empty matches all), ``op`` (exact storage op name or drivemon op class
+read/write/stat/delete; ``*`` matches all), ``latency_ms``,
+``probability`` (default 1.0), ``after`` (skip the first N matching
+occurrences), ``count`` (fire at most N times; 0 = unlimited).
+
+The ``crash`` kind is the crash-consistency harness's lever: the
+commit paths in ``storage/xl.py`` / ``erasure/engine.py`` /
+``erasure/multipart.py`` / ``erasure/heal.py`` declare NAMED crash
+points (:meth:`FaultInjector.crash_point`) at every boundary where a
+process death leaves interesting on-disk state — post-tmp-write,
+between per-disk shard commits, mid multipart hard-link loop,
+straddling the xl.meta replace, mid heal write-back. A fired crash
+rule calls ``os._exit(137)``: no atexit handlers, no flushes, no
+finally blocks — the closest in-process stand-in for SIGKILL, so the
+restart-and-assert harness (tests/test_crash_consistency.py)
+exercises REAL torn state, not a politely unwound exception. Points
+register at import time, so the admin ``/fault-inject`` GET can
+enumerate coverage (name + traversal count + armed flag) before any
+traffic flows.
 
 Determinism: whether occurrence ``n`` of a rule fires is a pure
 function of (seed, rule index, n) — a SHA-256-derived fraction compared
@@ -52,11 +67,12 @@ from __future__ import annotations
 
 import hashlib
 import json
+import os
 import threading
 import time
 
 KINDS = ("latency", "error", "corrupt", "torn_write", "partition",
-         "slow_wire", "kernel")
+         "slow_wire", "kernel", "crash")
 
 # kinds consulted at each hook
 _DISK_KINDS = ("latency", "error")
@@ -131,12 +147,33 @@ class FaultInjector:
     it (lint R3 — a fault injector must not serialize the fan-outs it
     is trying to perturb)."""
 
+    # Test seam for the crash kind: the harness's subprocess servers
+    # die for real; in-process unit tests swap this for a recorder.
+    # os._exit, not sys.exit: no atexit, no finally, no flushes — the
+    # whole point is that NOTHING between the crash point and the
+    # kernel runs.
+    _exit = staticmethod(os._exit)
+    CRASH_EXIT_CODE = 137  # what a SIGKILL-ed process reports
+
     def __init__(self):
         self.enabled = False
         self._mu = threading.Lock()
         self._rules: list[_Rule] = []
         self._seed = 0
         self._loaded_at = 0.0
+        # Named crash points, declared at import time by the modules
+        # that host them (name -> traversals observed while a plan was
+        # armed). Static registration is deliberate: the harness
+        # enumerates coverage from the admin GET, so a point that is
+        # never traversed must still be LISTED (a registry built from
+        # traffic would silently under-report coverage).
+        self._crash_points: dict[str, int] = {}
+        # Fires recorded AT the point itself, not inferred from rule
+        # counters — a broad rule target matches many points, and its
+        # fired total must not smear across all of them. (Almost
+        # always unobservable post-fire — the process exits — but an
+        # inferred-wrong positive is worse than an honest zero.)
+        self._crash_fired: dict[str, int] = {}
 
     # -- plan management ----------------------------------------------
 
@@ -176,11 +213,40 @@ class FaultInjector:
             from ..logger import Logger
             Logger.get().info("faultinject: plan cleared", "faultinject")
 
+    def register_crash_point(self, name: str) -> str:
+        """Declare a named crash point (module-import time). Idempotent;
+        returns the name so hook modules can keep the constant."""
+        with self._mu:
+            self._crash_points.setdefault(name, 0)
+        return name
+
+    def crash_points(self) -> list[str]:
+        with self._mu:
+            return sorted(self._crash_points)
+
     def snapshot(self) -> dict:
         with self._mu:
+            armed = set()
+            for r in self._rules:
+                if r.kind != "crash":
+                    continue
+                for name in self._crash_points:
+                    if not r.target or r.target in name:
+                        armed.add(name)
             return {"active": self.enabled, "seed": self._seed,
                     "loadedAt": self._loaded_at,
-                    "rules": [r.to_dict() for r in self._rules]}
+                    "rules": [r.to_dict() for r in self._rules],
+                    # Per-point coverage counters for the crash
+                    # harness and operators: hits counts traversals
+                    # observed while a plan was armed (the no-plan hot
+                    # path is one attribute read and counts nothing);
+                    # fired counts kills AT the point.
+                    "crashPoints": [
+                        {"name": name, "hits": hits,
+                         "armed": name in armed,
+                         "fired": self._crash_fired.get(name, 0)}
+                        for name, hits in sorted(
+                            self._crash_points.items())]}
 
     # -- deterministic decision ---------------------------------------
 
@@ -293,6 +359,34 @@ class FaultInjector:
             return
         if self._collect(("kernel",), name):
             raise InjectedFault(f"injected kernel-dispatch fault: {name}")
+
+    def crash_point(self, name: str) -> None:
+        """Named commit-path crash point: when an armed ``crash`` rule
+        matches, the PROCESS DIES HERE via os._exit(137) — no
+        exception, no cleanup, no flush. ``after``/``count``/
+        ``probability`` apply as usual, so a harness can let N disks
+        commit before the kill lands mid-fan-out. With no plan loaded
+        this is a single attribute read (the hook sits on the PUT
+        commit path)."""
+        if not self.enabled:
+            return
+        with self._mu:
+            if name in self._crash_points:
+                self._crash_points[name] += 1
+        if self._collect(("crash",), name):
+            with self._mu:
+                self._crash_fired[name] = \
+                    self._crash_fired.get(name, 0) + 1
+            # Best-effort breadcrumb; os._exit will NOT flush it, and
+            # that is correct — a real power cut doesn't either.
+            try:
+                from ..logger import Logger
+                Logger.get().info(
+                    f"faultinject: crash point {name} fired — "
+                    f"exiting {self.CRASH_EXIT_CODE}", "faultinject")
+            except Exception:
+                pass
+            self._exit(self.CRASH_EXIT_CODE)
 
 
 # The process-wide injector every hook point shares.

@@ -382,6 +382,18 @@ METRICS2.register(
     "Faults injected by the runtime fault-injection subsystem, "
     "by kind.")
 METRICS2.register(
+    "minio_tpu_v2_mrf_journal_backlog", "gauge",
+    "Live entries in the durable MRF journal (.minio.sys/mrf.log): "
+    "queued repairs that survive a crash and replay at boot.")
+METRICS2.register(
+    "minio_tpu_v2_mrf_journal_drops_total", "counter",
+    "Repairs whose journal append was dropped at the size cap — "
+    "queued in memory but NOT crash-durable.")
+METRICS2.register(
+    "minio_tpu_v2_recovery_swept_total", "counter",
+    "Boot-time recovery sweep results, by what (found/cleaned/"
+    "stage_files/requeued/journal_replayed).")
+METRICS2.register(
     "minio_tpu_v2_cache_hits_total", "counter",
     "Hot-object cache hits, by tier (mem/disk).")
 METRICS2.register(

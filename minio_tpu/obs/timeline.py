@@ -232,6 +232,11 @@ class Timeline:
                 m("minio_tpu_v2_cache_fills_total")),
             "cacheBytes": _series_sum(m("minio_tpu_v2_cache_bytes")),
             "mrfDepth": _series_sum(m("minio_tpu_v2_mrf_queue_depth")),
+            # Durable-queue twin of mrfDepth: live entries in the
+            # per-set MRF journal (watchdog recovery_backlog watches
+            # its growth).
+            "mrfJournal": _series_sum(
+                m("minio_tpu_v2_mrf_journal_backlog")),
             "drives": {"suspect": suspect, "faulty": faulty,
                        "quarantined":
                            len(DRIVEMON.quarantined_endpoints())},
@@ -309,6 +314,7 @@ class Timeline:
                                  prev.get("cacheFills", 0)),
                 "cacheBytes": raw.get("cacheBytes", 0),
                 "mrfDepth": raw["mrfDepth"],
+                "mrfJournal": raw.get("mrfJournal", 0),
                 "drives": dict(raw["drives"]),
                 "backendState": dict(raw["backendState"]),
                 # Alert census at sample time (the watchdog evaluates
@@ -405,6 +411,7 @@ def _collapse_node(snap: dict, period_s: float) -> list[dict]:
             "cacheHits": 0, "cacheMisses": 0, "cacheFills": 0,
             "cacheBytes": last.get("cacheBytes", 0),
             "mrfDepth": last.get("mrfDepth", 0),
+            "mrfJournal": last.get("mrfJournal", 0),
             "drives": dict(last.get("drives") or {}),
             # Census, not a counter: the node's LATEST alert state.
             "alerts": dict(last.get("alerts") or {}),
@@ -461,7 +468,8 @@ def merge_timelines(snapshots: list[dict],
                     "inflight": {},
                     "queueDepth": 0, "rx": 0, "tx": 0,
                     "kernelBytes": {}, "kernelGiBs": {},
-                    "hedgeFired": 0, "mrfDepth": 0, "resets": 0,
+                    "hedgeFired": 0, "mrfDepth": 0, "mrfJournal": 0,
+                    "resets": 0,
                     "cacheHits": 0, "cacheMisses": 0,
                     "cacheFills": 0, "cacheBytes": 0,
                     "drives": {"suspect": 0, "faulty": 0,
@@ -476,8 +484,9 @@ def merge_timelines(snapshots: list[dict],
                 for k, v in (s.get(fld) or {}).items():
                     cur[fld][k] = cur[fld].get(k, 0) + v
             for fld in ("queueDepth", "rx", "tx", "hedgeFired",
-                        "mrfDepth", "cacheHits", "cacheMisses",
-                        "cacheFills", "cacheBytes", "resets"):
+                        "mrfDepth", "mrfJournal", "cacheHits",
+                        "cacheMisses", "cacheFills", "cacheBytes",
+                        "resets"):
                 cur[fld] += s.get(fld, 0)
             for k, v in (s.get("drives") or {}).items():
                 cur["drives"][k] = cur["drives"].get(k, 0) + v

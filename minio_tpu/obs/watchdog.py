@@ -233,6 +233,37 @@ class MrfRule:
         return True, cause, float(tail[-1])
 
 
+class RecoveryRule:
+    """Durable MRF journal backlog growing monotonically: crash-
+    journaled repairs (erasure/mrfjournal.py) are accumulating faster
+    than heal retires them — replay after the NEXT crash will re-queue
+    an ever-larger debt, and the sweep/journal loop is not converging.
+    Extends the in-memory ``mrf_backlog`` pattern to the durable
+    queue: the memory rule catches a stalled worker, this one catches
+    repairs that keep FAILING (each failed heal keeps its journal
+    entry; see MRFQueue._heal)."""
+
+    name = "recovery_backlog"
+    kind = "event"
+    GROW_TICKS = 5    # consecutive samples the backlog must not shrink
+    MIN_DEPTH = 8     # and the latest backlog must reach this
+
+    def evaluate(self, ctx: _EvalCtx):
+        tail = [s.get("mrfJournal", 0) or 0
+                for s in ctx.samples[-(self.GROW_TICKS + 1):]]
+        if len(tail) < self.GROW_TICKS + 1 \
+                or tail[-1] < self.MIN_DEPTH:
+            return False, "", 0.0
+        if not (all(b >= a for a, b in zip(tail, tail[1:]))
+                and tail[-1] > tail[0]):
+            return False, "", 0.0
+        cause = (f"durable MRF journal backlog growing "
+                 f"{tail[0]:g} -> {tail[-1]:g} over "
+                 f"{self.GROW_TICKS} samples (repairs journaled "
+                 "faster than heal retires them)")
+        return True, cause, float(tail[-1])
+
+
 class CacheRule:
     """Hot-cache hit-ratio collapse: a cache that WAS serving (slow
     window healthy) suddenly missing everything — invalidation storm,
@@ -357,7 +388,7 @@ def validate_user_rules(raw: str) -> list[dict]:
     registered = METRICS2.registered_names()
     builtin = {name for name, _, _ in BURN_SIGNALS} | {
         DriveRule.name, BackendRule.name, MrfRule.name,
-        CacheRule.name, ResetRule.name}
+        RecoveryRule.name, CacheRule.name, ResetRule.name}
     seen: set[str] = set()
     out: list[dict] = []
     for i, r in enumerate(doc):
@@ -571,8 +602,8 @@ class Watchdog:
         rules: dict[str, object] = {}
         for name, key, what in BURN_SIGNALS:
             rules[name] = BurnRule(name, key, what)
-        for r in (DriveRule(), BackendRule(), MrfRule(), CacheRule(),
-                  ResetRule()):
+        for r in (DriveRule(), BackendRule(), MrfRule(),
+                  RecoveryRule(), CacheRule(), ResetRule()):
             rules[r.name] = r
         for doc in user_docs:
             r = ThresholdRule(doc)

@@ -677,6 +677,22 @@ class AdminHandlers:
         out["mrf"] = self.server._mrf_stats()
         return out
 
+    def h_recovery(self, p, body):
+        """Boot-time crash-recovery report (storage/recovery.py): per
+        erasure set, the staging residue found/cleaned, objects
+        requeued for heal, MRF journal entries replayed, and the sweep
+        duration — plus the journal's live census so an operator can
+        see the durable backlog draining."""
+        journals = []
+        if self.server.layer is not None:
+            for pool in _pools(self.server.layer):
+                for es in pool.sets:
+                    mrf = getattr(es, "mrf", None)
+                    if mrf is not None and hasattr(mrf, "journal"):
+                        journals.append(mrf.journal.stats())
+        return {"sweeps": getattr(self.server, "recovery_reports", []),
+                "journals": journals}
+
     # -- runtime fault injection (minio_tpu/faultinject) ---------------
 
     def h_fault_inject(self, p, body):

@@ -207,3 +207,24 @@ class AdminClient:
 
     def top_locks(self) -> list:
         return self._call("GET", "top-locks")["locks"]
+
+    # -- robustness -----------------------------------------------------
+
+    def fault_inject(self, plan: dict | None = None,
+                     clear: bool = False) -> dict:
+        """Load (POST), clear (?clear=true), or inspect (bare GET —
+        rules with seen/fired counters plus the registered crash-point
+        inventory) the runtime fault plan."""
+        if clear:
+            return self._call("POST", "fault-inject", {"clear": "true"})
+        if plan is not None:
+            import json as _json
+            return self._call("POST", "fault-inject",
+                              body=_json.dumps(plan).encode())
+        return self._call("GET", "fault-inject")
+
+    def recovery(self) -> dict:
+        """Boot-time crash-recovery report: per-set sweep results
+        (staging residue GC'd, objects requeued, journal entries
+        replayed) + the live durable-MRF journal census."""
+        return self._call("GET", "recovery")

@@ -2208,6 +2208,13 @@ class S3Server:
         self.config.validators.append(self._validate_config)
         self.config.on_change(self._apply_config)
         self._apply_config(self.config)
+        # Boot-time crash recovery: GC orphaned staging residue
+        # (age-gated), requeue partially-committed objects, replay the
+        # durable MRF journal — synchronously, so the report (and the
+        # replayed mrf_queue_depth) exists before the first request is
+        # served (storage/recovery.py).
+        from ..storage.recovery import sweep_layer
+        self.recovery_reports = sweep_layer(layer)
 
     def _validate_config(self, subsys: str, target: str,
                          kvs: dict) -> None:
@@ -2361,6 +2368,11 @@ class S3Server:
                         raise ValueError(
                             f"rpc offline_retry={v!r}: must be a "
                             "positive duration like 2s / 500ms")
+        if subsys == "storage":
+            for key, v in kvs.items():
+                if key == "fsync" and v not in ("on", "off"):
+                    raise ValueError(
+                        f"storage fsync={v!r}: must be on/off")
         if subsys == "fault_inject":
             for key, v in kvs.items():
                 if key == "enable":
@@ -2450,6 +2462,12 @@ class S3Server:
             from ..logger import Logger
             Logger.get().log_once(
                 f"rpc config invalid, keeping previous: {e}", "config")
+        # Commit-path fsync policy flips live (storage/xl.py
+        # commit_replace); env MINIO_STORAGE_FSYNC wins via the
+        # config's env-first rule. Anything but an explicit "on" is
+        # off — durability must be asked for, never inferred.
+        from ..storage.xl import set_fsync
+        set_fsync(cfg.get("storage", "fsync") == "on")
         # Fault-injection plan: applied only when the EFFECTIVE
         # fault_inject config changed — the apply hook runs on every
         # config write, and an unrelated change must not clobber a

@@ -6,9 +6,21 @@ On-disk layout per disk root (same shape as the reference):
     <root>/<bucket>/<object>/xl.meta       version metadata (JSON, metadata.py)
     <root>/<bucket>/<object>/<dataDir>/part.N   bitrot-wrapped shard files
 
-Writes are crash-safe: tmp file + fsync-less atomic os.replace (the
-reference's reliable-rename pattern, cmd/os-reliable.go); object commit is
-rename_data (ref cmd/xl-storage.go:1972).
+Writes are crash-safe: tmp file + atomic replace (the reference's
+reliable-rename pattern, cmd/os-reliable.go); object commit is
+rename_data (ref cmd/xl-storage.go:1972). Every commit-path replace
+goes through ONE blessed helper, :func:`commit_replace` (enforced by
+mtpu-lint R7): by default it is fsync-less (page-cache crash window,
+like the reference's default), and the ``storage fsync=on`` config-KV
+knob routes the same helper through fsync-file + fsync-parent-dir for
+power-cut durability at a measured latency cost (docs/robustness.md).
+
+Crash consistency is TESTED, not assumed: rename_data hosts named
+crash points (minio_tpu/faultinject crash kind) at the torn-state
+boundaries — before the data-dir replace, between the replace and the
+xl.meta merge, and after the meta write — which the subprocess harness
+(tests/test_crash_consistency.py) arms to kill -9 the server
+mid-commit and assert the restart invariants.
 """
 
 from __future__ import annotations
@@ -25,6 +37,17 @@ from .metadata import XL_META_FILE, FileInfo, XLMeta
 from ..erasure import bitrot
 from ..faultinject import FAULTS
 from ..obs.drivemon import DRIVEMON, is_drive_fault
+
+# Named crash points on the per-disk commit (rename_data) — the three
+# windows a process death leaves distinguishable on-disk state. The
+# crash harness arms these with `after` counts to land the kill
+# BETWEEN disks of one quorum fan-out.
+CRASH_RENAME_PRE = FAULTS.register_crash_point(
+    "xl.rename_data.pre_replace")
+CRASH_RENAME_MID = FAULTS.register_crash_point(
+    "xl.rename_data.post_replace")
+CRASH_RENAME_POST = FAULTS.register_crash_point(
+    "xl.rename_data.post_meta")
 from ..obs.metrics2 import METRICS2
 from ..obs.span import TRACER
 
@@ -74,8 +97,62 @@ TMP_DIR = ".minio.sys/tmp"
 # Staging prefix inside the MINIO_META_BUCKET volume (engine + healer
 # share this single source of truth).
 TMP_PATH = "tmp"
+# Recovery breadcrumb the engine drops into each staging dir (tiny
+# JSON: bucket/object/versionId/dataDir): after a crash, the boot
+# recovery sweep (storage/recovery.py) reads it to requeue the object
+# for heal before GC-ing the orphaned stage.
+INTENT_FILE = "intent.json"
 
 _RESERVED_VOLUMES = {MINIO_META_BUCKET}
+
+# `storage fsync=on` (config-KV; env MINIO_STORAGE_FSYNC): when True,
+# commit_replace fsyncs the source (each file of a staged data dir)
+# and the destination's parent directory around the rename, closing
+# the power-cut window the fsync-less default leaves open. Process-
+# wide on purpose — durability is a deployment property, not a
+# per-call one.
+FSYNC = False
+
+
+def set_fsync(on: bool) -> None:
+    """Flip the commit-path fsync policy (config apply hook)."""
+    global FSYNC
+    FSYNC = bool(on)
+
+
+def _fsync_fd_of(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_src(path: str) -> None:
+    """Flush a commit source: a staged data DIR syncs each shard file
+    then the dir entries; a plain file syncs itself."""
+    if os.path.isdir(path):
+        for entry in os.scandir(path):
+            if entry.is_file(follow_symlinks=False):
+                _fsync_fd_of(entry.path)
+        _fsync_fd_of(path)
+    else:
+        _fsync_fd_of(path)
+
+
+def commit_replace(src: str, dst: str) -> None:
+    """The ONE blessed commit-path rename (mtpu-lint R7): every
+    os.replace/os.rename under minio_tpu/storage/ must route here, so
+    the fsync policy — and any future commit-ordering change — has a
+    single choke point instead of N hand-synced call sites.
+    FileNotFoundError propagates unchanged (callers resolve it into
+    their typed volume/race conditions)."""
+    if FSYNC:
+        _fsync_src(src)
+    # mtpu-lint: disable=R7 -- the blessed helper itself; every other replace routes here
+    os.replace(src, dst)
+    if FSYNC:
+        _fsync_fd_of(os.path.dirname(dst))
 
 
 def _is_valid_volume(volume: str) -> bool:
@@ -229,7 +306,7 @@ class XLStorage(StorageAPI):
             with f:
                 f.write(data)
             try:
-                os.replace(tmp, full)
+                commit_replace(tmp, full)
             except FileNotFoundError:
                 # Target dir vanished mid-write (racing force
                 # delete-bucket rmtree, or delete()'s empty-parent
@@ -243,7 +320,7 @@ class XLStorage(StorageAPI):
                     raise
                 self._makedirs_for(volume, os.path.dirname(full))
                 try:
-                    os.replace(tmp, full)
+                    commit_replace(tmp, full)
                 except FileNotFoundError as e:
                     # Deleted again between retry-mkdir and replace:
                     # the volume is being torn down right now.
@@ -393,7 +470,7 @@ class XLStorage(StorageAPI):
                 except FileNotFoundError:
                     os.makedirs(os.path.dirname(tmp), exist_ok=True)
                     os.link(src, tmp)
-                os.replace(tmp, dst)
+                commit_replace(tmp, dst)
         except FileNotFoundError:
             raise serr.FileNotFound(f"{src_volume}/{src_path}")
         except OSError as e:
@@ -411,7 +488,7 @@ class XLStorage(StorageAPI):
             raise serr.FileNotFound(f"{src_volume}/{src_path}")
         self._makedirs_for(dst_volume, os.path.dirname(dst))
         try:
-            os.replace(src, dst)
+            commit_replace(src, dst)
         except OSError as e:
             raise serr.FaultyDisk(str(e))
 
@@ -467,8 +544,12 @@ class XLStorage(StorageAPI):
                 raise serr.FileNotFound(f"{src_volume}/{src_path}")
             if os.path.isdir(dst_dd):
                 shutil.rmtree(dst_dd)
+            # Crash window A: shards fully staged, nothing visible yet
+            # — a death here must leave the OLD version intact and the
+            # stage for the boot sweep to GC.
+            FAULTS.crash_point(CRASH_RENAME_PRE)
             try:
-                os.replace(src_dd, dst_dd)
+                commit_replace(src_dd, dst_dd)
             except FileNotFoundError:
                 # dst object dir vanished between the makedirs above
                 # and the replace (racing force delete-bucket, or a
@@ -479,9 +560,14 @@ class XLStorage(StorageAPI):
                 # bucket is never resurrected).
                 self._makedirs_for(dst_volume, dst_obj_dir)
                 try:
-                    os.replace(src_dd, dst_dd)
+                    commit_replace(src_dd, dst_dd)
                 except FileNotFoundError as e:
                     raise serr.VolumeNotFound(dst_volume) from e
+        # Crash window B: the new data dir is in place but xl.meta
+        # still names the old version — a death here must read as the
+        # OLD version (the orphaned new data dir is invisible until
+        # the meta merge below lands, and heal GCs it).
+        FAULTS.crash_point(CRASH_RENAME_MID)
         try:
             meta = self._read_xlmeta(dst_volume, dst_path)
         except serr.FileNotFound:
@@ -505,14 +591,24 @@ class XLStorage(StorageAPI):
             self._file_path(dst_volume,
                             os.path.join(dst_path, XL_META_FILE)),
             meta.dump(), volume=dst_volume, dir_ready=True)
+        # Crash window C: the NEW version is fully committed on this
+        # disk; only garbage collection (old data dir, stage dir)
+        # remains — a death here must read as the new version with
+        # the leftovers swept at next boot.
+        FAULTS.crash_point(CRASH_RENAME_POST)
         if old and old.get("dataDir") and old["dataDir"] != fi.data_dir:
             old_dd = os.path.join(dst_obj_dir, old["dataDir"])
             if os.path.isdir(old_dd):
                 shutil.rmtree(old_dd, ignore_errors=True)
-        # Clean the tmp staging dir — empty after the data-dir replace
-        # above, so a bare rmdir does it (rmtree's listdir walk only
-        # for the unusual leftover case).
+        # Clean the tmp staging dir — after the data-dir replace only
+        # the recovery intent breadcrumb remains, so one targeted
+        # unlink + bare rmdir does it (rmtree's listdir walk only for
+        # the unusual leftover case).
         src_dir = self._file_path(src_volume, src_path)
+        try:
+            os.remove(os.path.join(src_dir, INTENT_FILE))
+        except OSError:
+            pass
         try:
             os.rmdir(src_dir)
         except OSError:

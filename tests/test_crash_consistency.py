@@ -1,0 +1,428 @@
+"""Crash-consistency harness: REAL kill -9 at every registered commit-
+path crash point, over a real ``python -m minio_tpu server`` process
+on persistent dirs.
+
+Per crash point the drill is: seed an OLD version, arm the point over
+the admin /fault-inject API (kind "crash" fires ``os._exit(137)`` —
+the SIGKILL-equivalent, no unwinding), drive the matching workload
+(PUT / multipart complete / heal write-back) until the process dies,
+restart ON THE SAME DISKS, and assert the recovery invariants:
+
+  I1  GET serves the old bytes or the new bytes, byte-exact — never a
+      torn mix, never a quorum 5xx;
+  I2  LIST agrees with what GET serves (size/etag consistency);
+  I3  the boot recovery sweep leaves ``.minio.sys/tmp`` empty on every
+      disk (staging residue GC'd; transient heal staging drains);
+  I4  repeated GETs agree (no flapping between versions).
+
+Plus the durable-MRF drill: degrade writes against one disk, queue
+repairs, SIGKILL before they drain, restart, and assert the journal
+replays them and heal converges — the repair debt survives the crash.
+
+The same process also pins the admin surface satellite: /fault-inject
+GET enumerates the registered crash-point inventory with armed
+counters, and admin /recovery reports the sweep.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from minio_tpu.s3.admin_client import AdminClient
+from minio_tpu.s3.client import S3Client
+
+ACCESS, SECRET = "crashadmin", "crashadmin-secret"
+N_DISKS = 6  # EC 3+3: read quorum 3, write quorum 4
+EXIT_CRASH = 137
+_NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+class Node:
+    """One single-node server the harness kills and restarts on the
+    same disks."""
+
+    def __init__(self, root):
+        self.root = str(root)
+        self.disks = [os.path.join(self.root, f"d{i}")
+                      for i in range(1, N_DISKS + 1)]
+        self.log = os.path.join(self.root, "node.log")
+        self.proc = None
+        self.port = None
+        self._log_off = 0
+
+    def start(self, timeout=90):
+        # One port for the node's lifetime: clients built before a
+        # crash stay valid across the restart.
+        if self.port is None:
+            self.port = _free_port()
+        else:
+            # Restart after a crash: let the orphaned staging residue
+            # clear the 1s recovery age gate — a fast boot can reach
+            # the sweep in under a second.
+            time.sleep(1.2)
+        env = dict(
+            os.environ, MINIO_ACCESS_KEY=ACCESS,
+            MINIO_SECRET_KEY=SECRET, JAX_PLATFORMS="cpu",
+            # The harness's orphans are seconds old; the default 60s
+            # gate would spare them for a boot. Restart latency (>1s
+            # of interpreter+import time) keeps live writes safe.
+            MINIO_RECOVERY_TMP_AGE="1",
+            MINIO_CRAWLER_INTERVAL="3600",
+            MINIO_HEAL_NEWDISK_INTERVAL="3600")
+        try:
+            self._log_off = os.path.getsize(self.log)
+        except OSError:
+            self._log_off = 0
+        log = open(self.log, "ab")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "minio_tpu", "server", *self.disks,
+             "--address", f"127.0.0.1:{self.port}"],
+            stdout=log, stderr=subprocess.STDOUT, env=env,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+        log.close()
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                with open(self.log, "rb") as f:
+                    f.seek(self._log_off)
+                    if b"listening on" in f.read():
+                        return
+            except FileNotFoundError:
+                pass
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"server died during boot: rc={self.proc.returncode}"
+                    f"\n{open(self.log, 'rb').read()[-2000:]}")
+            time.sleep(0.1)
+        raise TimeoutError("server not ready")
+
+    def alive(self):
+        return self.proc is not None and self.proc.poll() is None
+
+    def wait_dead(self, timeout=60):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.proc.poll() is not None:
+                return self.proc.returncode
+            time.sleep(0.05)
+        raise TimeoutError("server did not die")
+
+    def kill9(self):
+        self.proc.send_signal(signal.SIGKILL)
+        self.proc.wait(timeout=10)
+
+    def stop(self):
+        if self.alive():
+            self.proc.send_signal(signal.SIGKILL)
+            self.proc.wait(timeout=10)
+
+    def client(self):
+        return S3Client("127.0.0.1", self.port, ACCESS, SECRET)
+
+    def admin(self):
+        return AdminClient("127.0.0.1", self.port, ACCESS, SECRET)
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    n = Node(tmp_path_factory.mktemp("crash"))
+    n.start()
+    c = n.client()
+    assert c.make_bucket("crashb").status == 200
+    yield n
+    n.stop()
+
+
+# ---------------------------------------------------------------------------
+# harness helpers
+
+
+def _arm(node, point, after=0):
+    res = node.admin().fault_inject(
+        {"rules": [{"kind": "crash", "target": point, "after": after}]})
+    assert res["ok"] and res["active"]
+
+
+def _drive_puts_until_dead(node, key, body, timeout=60):
+    """PUT the new body in a loop until the armed crash point kills
+    the process; assert the death is the crash exit, not an
+    accident."""
+    c = node.client()
+    deadline = time.time() + timeout
+    while time.time() < deadline and node.alive():
+        try:
+            c.put_object("crashb", key, body)
+        except Exception:
+            pass  # connection died mid-request: expected at the kill
+    rc = node.wait_dead()
+    assert rc == EXIT_CRASH, f"unexpected death rc={rc}"
+
+
+def _staging_dirs(node):
+    out = []
+    for d in node.disks:
+        tmp = os.path.join(d, ".minio.sys", "tmp")
+        try:
+            out.extend(os.path.join(tmp, x) for x in os.listdir(tmp))
+        except OSError:
+            pass
+    return out
+
+
+def _assert_staging_drains(node, timeout=15):
+    """I3: post-restart, staging is empty on every disk. A requeued
+    heal may stage transiently; poll until it drains."""
+    deadline = time.time() + timeout
+    leftovers = _staging_dirs(node)
+    while time.time() < deadline:
+        leftovers = _staging_dirs(node)
+        if not leftovers:
+            return
+        time.sleep(0.25)
+    raise AssertionError(f"staging residue survived: {leftovers}")
+
+
+def _assert_invariants(node, key, old, new):
+    """I1/I2/I4 for one key; returns the served body."""
+    c = node.client()
+    g1 = c.get_object("crashb", key)
+    assert g1.status == 200, (g1.status, g1.body[:300])
+    assert g1.body in (old, new), (
+        f"torn object: {len(g1.body)} bytes is neither old "
+        f"({len(old)}) nor new ({len(new)})")
+    g2 = c.get_object("crashb", key)
+    assert g2.status == 200 and g2.body == g1.body, "GETs flapped"
+    li = c.list_objects_v2("crashb", prefix=key)
+    assert li.status == 200
+    sizes = {e.findtext(f"{_NS}Key"): int(e.findtext(f"{_NS}Size"))
+             for e in ET.fromstring(li.body).findall(f"{_NS}Contents")}
+    assert sizes.get(key) == len(g1.body), (
+        f"LIST disagrees with GET: {sizes.get(key)} != {len(g1.body)}")
+    return g1.body
+
+
+# ---------------------------------------------------------------------------
+# satellite: the admin inventory the harness itself enumerates
+
+
+def test_fault_inject_lists_crash_point_inventory(node):
+    adm = node.admin()
+    snap = adm.fault_inject()
+    points = {p["name"]: p for p in snap["crashPoints"]}
+    assert len(points) >= 8, sorted(points)
+    for prefix in ("xl.rename_data.", "engine.put.",
+                   "engine.multipart.", "engine.heal."):
+        assert any(name.startswith(prefix) for name in points), prefix
+    assert not any(p["armed"] for p in points.values())
+    _arm(node, "engine.put.post_stage", after=10_000)
+    armed = {p["name"]: p["armed"]
+             for p in adm.fault_inject()["crashPoints"]}
+    assert armed["engine.put.post_stage"] is True
+    assert armed["engine.multipart.pre_commit"] is False
+    adm.fault_inject(clear=True)
+
+
+# ---------------------------------------------------------------------------
+# PUT commit path (5 points: staged, per-disk windows A/B/C, committed)
+
+PUT_POINTS = [
+    # (point, after, expect) — expect: "old" (died pre-quorum),
+    # "new" (died post-quorum), "either" (died mid-fan-out; both are
+    # legal outcomes, torn/5xx is not).
+    ("engine.put.post_stage", 0, "old"),
+    ("xl.rename_data.pre_replace", 2, "either"),
+    ("xl.rename_data.post_replace", 4, "either"),
+    ("xl.rename_data.post_meta", 4, "either"),
+    ("engine.put.post_commit", 0, "new"),
+]
+
+
+@pytest.mark.parametrize("point,after,expect",
+                         PUT_POINTS, ids=[p for p, _, _ in PUT_POINTS])
+def test_put_crash_point(node, point, after, expect):
+    key = "put-" + point.replace(".", "-")
+    old = (b"OLD:" + point.encode() + b":") * 4000
+    new = os.urandom(96_000)
+    c = node.client()
+    assert c.put_object("crashb", key, old).status == 200
+    _arm(node, point, after=after)
+    _drive_puts_until_dead(node, key, new)
+    node.start()  # same disks; plan died with the process
+    served = _assert_invariants(node, key, old, new)
+    if expect == "old":
+        assert served == old, f"{point}: pre-quorum death must not publish"
+    elif expect == "new":
+        assert served == new, f"{point}: post-quorum death must serve the commit"
+    _assert_staging_drains(node)
+
+
+# ---------------------------------------------------------------------------
+# multipart complete (3 points: pre-commit, mid hard-link loop,
+# committed-but-not-reclaimed)
+
+
+def _multipart_upload(c, key, part_bodies):
+    r = c.request("POST", f"/crashb/{key}", query="uploads")
+    assert r.status == 200, r.body
+    upload_id = ET.fromstring(r.body).findtext(f"{_NS}UploadId")
+    etags = []
+    for i, body in enumerate(part_bodies, start=1):
+        r = c.request("PUT", f"/crashb/{key}",
+                      query=f"partNumber={i}&uploadId={upload_id}",
+                      body=body)
+        assert r.status == 200, r.body
+        etags.append(r.headers.get("etag", "").strip('"'))
+    return upload_id, etags
+
+
+def _complete_doc(etags):
+    parts = "".join(
+        f"<Part><PartNumber>{i}</PartNumber><ETag>\"{e}\"</ETag></Part>"
+        for i, e in enumerate(etags, start=1))
+    return (f"<CompleteMultipartUpload>{parts}"
+            "</CompleteMultipartUpload>").encode()
+
+
+MPU_POINTS = [
+    ("engine.multipart.pre_commit", 0),
+    ("engine.multipart.mid_link", 5),
+    ("engine.multipart.post_commit", 0),
+]
+
+
+@pytest.mark.parametrize("point,after",
+                         MPU_POINTS, ids=[p for p, _ in MPU_POINTS])
+def test_multipart_complete_crash_point(node, point, after):
+    key = "mpu-" + point.replace(".", "-")
+    old = b"OLDMPU" * 10_000
+    part1 = os.urandom(5 * 1024 * 1024)  # min size for a non-last part
+    part2 = os.urandom(120_000)
+    new = part1 + part2
+    c = node.client()
+    assert c.put_object("crashb", key, old).status == 200
+    upload_id, etags = _multipart_upload(c, key, [part1, part2])
+    _arm(node, point, after=after)
+    try:
+        c.request("POST", f"/crashb/{key}", query=f"uploadId={upload_id}",
+                  body=_complete_doc(etags))
+    except Exception:
+        pass  # died mid-complete: the point of the exercise
+    rc = node.wait_dead()
+    assert rc == EXIT_CRASH, f"unexpected death rc={rc}"
+    node.start()
+    served = _assert_invariants(node, key, old, new)
+    if served == old:
+        # Died before the commit landed: the upload must have
+        # survived, and a client retry of complete must succeed — the
+        # crash cost an RTT, not the upload.
+        r = c.request("POST", f"/crashb/{key}",
+                      query=f"uploadId={upload_id}",
+                      body=_complete_doc(etags))
+        assert r.status == 200, (point, r.status, r.body[:300])
+        assert node.client().get_object("crashb", key).body == new
+    _assert_staging_drains(node)
+
+
+# ---------------------------------------------------------------------------
+# heal write-back (2 points), + the sweep requeue closing the loop
+
+
+@pytest.mark.parametrize("point", ["engine.heal.mid_append",
+                                   "engine.heal.pre_commit"])
+def test_heal_writeback_crash_point(node, point):
+    import shutil
+    key = "heal-" + point.replace(".", "-")
+    body = os.urandom(200_000)
+    c = node.client()
+    assert c.put_object("crashb", key, body).status == 200
+    victim = None
+    for d in node.disks:
+        objdir = os.path.join(d, "crashb", key)
+        if os.path.isdir(objdir):
+            victim = d
+            shutil.rmtree(objdir)
+            break
+    assert victim
+    _arm(node, point)
+    try:
+        node.admin().heal("crashb", key)  # synchronous sweep hits the point
+    except Exception:
+        pass
+    rc = node.wait_dead()
+    assert rc == EXIT_CRASH, f"unexpected death rc={rc}"
+    node.start()
+    # I1: still byte-exact from the k survivors; staging drains after
+    # the sweep's requeue re-heals.
+    g = node.client().get_object("crashb", key)
+    assert g.status == 200 and g.body == body
+    _assert_staging_drains(node)
+    # Convergence backstop: heal again, then the victim carries the
+    # object (the crashed write-back was requeued, not lost).
+    node.admin().heal("crashb", key)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if os.path.exists(os.path.join(victim, "crashb", key, "xl.meta")):
+            break
+        time.sleep(0.25)
+        try:
+            node.admin().heal("crashb", key)
+        except Exception:
+            pass
+    assert os.path.exists(os.path.join(victim, "crashb", key, "xl.meta"))
+
+
+# ---------------------------------------------------------------------------
+# durable MRF: queued repairs survive a SIGKILL and replay at boot
+
+
+def test_mrf_journal_replays_after_sigkill(node):
+    c = node.client()
+    adm = node.admin()
+    # Degrade every write against one disk: each PUT queues (and
+    # journals) a repair for its key.
+    res = adm.fault_inject({"rules": [
+        {"kind": "error", "target": node.disks[5], "op": "write"}]})
+    assert res["active"]
+    keys = [f"journal-{i}" for i in range(5)]
+    for k in keys:
+        assert c.put_object("crashb", k, os.urandom(50_000)).status == 200
+    # The queued heals cannot converge (the disk keeps failing), so
+    # the journal holds them. SIGKILL discards the in-memory queue.
+    node.kill9()
+    node.start()  # plan died with the process: the disk is healthy
+    rep = adm.recovery()
+    replayed = sum(s.get("journalReplayed", 0) for s in rep["sweeps"])
+    assert replayed >= len(keys), rep
+    # The replayed backlog drains: every key converges onto the
+    # formerly-failing disk, and the journal empties.
+    deadline = time.time() + 45
+    missing = list(keys)
+    while time.time() < deadline:
+        missing = [k for k in keys if not os.path.exists(
+            os.path.join(node.disks[5], "crashb", k, "xl.meta"))]
+        if not missing:
+            break
+        time.sleep(0.5)
+    assert not missing, f"repairs not replayed/healed: {missing}"
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if sum(j["backlog"]
+               for j in adm.recovery()["journals"]) == 0:
+            break
+        time.sleep(0.5)
+    assert sum(j["backlog"] for j in adm.recovery()["journals"]) == 0

@@ -113,6 +113,64 @@ def test_kernel_hook_raises_only_for_matching_kernel():
 
 
 # ---------------------------------------------------------------------------
+# crash kind + crash-point registry
+
+
+def test_crash_point_fires_exit_with_after_count(monkeypatch):
+    inj = FaultInjector()
+    inj.register_crash_point("xl.test.point")
+    exits = []
+    monkeypatch.setattr(inj, "_exit", exits.append)
+    inj.load_plan({"rules": [
+        {"kind": "crash", "target": "xl.test.point", "after": 2}]})
+    inj.crash_point("xl.test.point")     # after-gated: survives
+    inj.crash_point("xl.other.point")    # non-matching: survives
+    inj.crash_point("xl.test.point")     # after-gated: survives
+    assert exits == []
+    inj.crash_point("xl.test.point")     # third matching occurrence
+    assert exits == [inj.CRASH_EXIT_CODE]
+
+
+def test_crash_point_noop_without_plan_and_registry_enumerates():
+    inj = FaultInjector()
+    inj.register_crash_point("engine.test.a")
+    inj.register_crash_point("engine.test.b")
+    # No plan: the hook is a no-op (and must not count traversals —
+    # the disabled hot path is one attribute read).
+    inj.crash_point("engine.test.a")
+    snap = inj.snapshot()
+    points = {p["name"]: p for p in snap["crashPoints"]}
+    assert set(points) == {"engine.test.a", "engine.test.b"}
+    assert points["engine.test.a"]["hits"] == 0
+    assert not points["engine.test.a"]["armed"]
+    # Armed plan: traversals count, the armed flag names coverage.
+    inj.load_plan({"rules": [
+        {"kind": "crash", "target": "engine.test.a", "after": 99}]})
+    inj.crash_point("engine.test.a")
+    inj.crash_point("engine.test.b")
+    points = {p["name"]: p
+              for p in inj.snapshot()["crashPoints"]}
+    assert points["engine.test.a"]["hits"] == 1
+    assert points["engine.test.a"]["armed"]
+    assert not points["engine.test.b"]["armed"]
+
+
+def test_registered_commit_path_crash_points_cover_the_matrix():
+    """The harness (tests/test_crash_consistency.py) enumerates
+    coverage from this registry: the acceptance floor is >= 8 points
+    spanning PUT, multipart complete, and heal write-back."""
+    import minio_tpu.erasure.heal        # noqa: F401 — registers points
+    import minio_tpu.erasure.multipart   # noqa: F401
+    import minio_tpu.storage.xl          # noqa: F401
+    points = FAULTS.crash_points()
+    assert len(points) >= 8
+    assert any(p.startswith("xl.rename_data.") for p in points)
+    assert any(p.startswith("engine.put.") for p in points)
+    assert any(p.startswith("engine.multipart.") for p in points)
+    assert any(p.startswith("engine.heal.") for p in points)
+
+
+# ---------------------------------------------------------------------------
 # hook points end-to-end (the scenarios the subsystem exists to prove)
 
 

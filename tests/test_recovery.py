@@ -1,0 +1,289 @@
+"""Crash-recovery building blocks, in-process: durable MRF journal
+(record/dedup/complete/compact/cap), MRFQueue add() dedup, journal
+replay across an engine "restart" (new engine, same dirs), and the
+boot-time recovery sweep (age-gated staging GC, intent-driven requeue,
+torn multipart stage cleanup). The REAL kill -9 flavors live in
+tests/test_crash_consistency.py."""
+
+import json
+import os
+import time
+
+from minio_tpu.erasure.mrfjournal import MRF_LOG_PATH, parse_journal
+from minio_tpu.storage.recovery import sweep_engine
+from minio_tpu.storage.xl import INTENT_FILE, XLStorage
+
+from tests.test_engine import make_engine  # noqa: F401
+
+
+def _no_worker(eng):
+    """Pin the MRF worker off so queued entries stay queued (add()'s
+    lazy start becomes a no-op; drain() still heals synchronously)."""
+    eng.mrf.start = lambda: None
+
+
+def _journal_files(eng):
+    out = []
+    for d in eng.disks:
+        p = os.path.join(d.root, ".minio.sys", MRF_LOG_PATH)
+        if os.path.exists(p):
+            out.append(p)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# MRF add() dedup (satellite) + journal record/complete
+
+
+def test_mrf_add_dedups_queued_objects(tmp_path):
+    eng = make_engine(tmp_path, n=4)
+    _no_worker(eng)
+    for _ in range(50):  # a flapping drive requeues the same repair
+        eng.mrf.add("b", "hot")
+    assert eng.mrf.depth() == 1
+    eng.mrf.add("b", "other")
+    assert eng.mrf.depth() == 2
+    # The journal deduped too: one line per object on every disk.
+    for p in _journal_files(eng):
+        assert parse_journal(open(p, "rb").read()) == [
+            ("b", "hot"), ("b", "other")]
+    assert len(_journal_files(eng)) == 4
+
+
+def test_mrf_heal_completion_retires_dedup_and_journal(tmp_path):
+    """A healed (here: vanished -> nothing-to-do) object leaves both
+    the dedup set and, once the journal empties, the mrf.log files;
+    the key becomes re-addable."""
+    eng = make_engine(tmp_path, n=4)
+    _no_worker(eng)
+    eng.make_bucket("b")
+    eng.mrf.add("b", "gone")  # object never existed: heal is a no-op
+    assert eng.mrf.depth() == 1
+    eng.mrf.drain()
+    assert eng.mrf.depth() == 0
+    assert eng.mrf.journal.backlog() == 0
+    # Truncate-on-empty: a healthy set carries no journal files.
+    assert _journal_files(eng) == []
+    eng.mrf.add("b", "gone")  # re-addable after completion
+    assert eng.mrf.depth() == 1
+
+
+def test_journal_survives_restart_and_replays(tmp_path):
+    """Entries journaled by one engine replay into a NEW engine on the
+    same dirs — the crash-survival contract — and the queue-depth
+    gauge reflects the replayed backlog."""
+    from minio_tpu.obs.metrics2 import METRICS2
+    eng = make_engine(tmp_path, n=4)
+    _no_worker(eng)
+    eng.mrf.add("b", "k1")
+    eng.mrf.add("b", "k2")
+    eng.mrf.add("b2", "k3")
+    assert eng.mrf.journal.backlog() == 3
+    eng.shutdown()  # "crash": the queue contents die with the process
+
+    eng2 = make_engine(tmp_path, n=4)
+    _no_worker(eng2)
+    assert eng2.mrf.depth() == 0
+    replayed = eng2.mrf.replay_journal()
+    assert replayed == 3
+    assert eng2.mrf.depth() == 3
+    assert METRICS2.get("minio_tpu_v2_mrf_queue_depth") == 3
+    # Replay seeds the dedup set: re-adding doesn't double-queue, and
+    # the journal files did not grow a second copy.
+    eng2.mrf.add("b", "k1")
+    assert eng2.mrf.depth() == 3
+    for p in _journal_files(eng2):
+        assert len(parse_journal(open(p, "rb").read())) == 3
+    eng2.shutdown()
+
+
+def test_journal_size_cap_counts_drops(tmp_path):
+    from minio_tpu.erasure.mrfjournal import MRFJournal
+    disks = [XLStorage(str(tmp_path / "d0"))]
+    j = MRFJournal(disks)
+    j.MAX_BYTES = 256
+    accepted = dropped = 0
+    for i in range(40):
+        if j.record("bucket", f"object-{i:04d}"):
+            accepted += 1
+        else:
+            dropped += 1
+    assert dropped > 0 and accepted > 0
+    assert j.drops == dropped
+    # The cap held on disk too.
+    p = os.path.join(disks[0].root, ".minio.sys", MRF_LOG_PATH)
+    assert os.path.getsize(p) <= 256 + 64  # one in-flight line of slack
+    # Torn tail tolerance: truncate mid-line, replay still parses.
+    raw = open(p, "rb").read()
+    open(p, "wb").write(raw[:-7])
+    j2 = MRFJournal(disks)
+    assert len(j2.replay()) >= accepted - 1
+
+
+def test_journal_parse_tolerates_garbage():
+    good = b'{"b":"x","o":"y"}\n'
+    assert parse_journal(
+        good + b"not json\n" + b'{"nope":1}\n' + good + b'{"b":"x"'
+    ) == [("x", "y")]
+
+
+# ---------------------------------------------------------------------------
+# boot-time recovery sweep
+
+
+def _stage_orphan(disk_root, name, intent=None, age_s=120.0):
+    """Plant an orphaned staging dir (optionally with an intent
+    breadcrumb), backdated past the age gate."""
+    d = os.path.join(disk_root, ".minio.sys", "tmp", name)
+    os.makedirs(os.path.join(d, "datadir-x"), exist_ok=True)
+    with open(os.path.join(d, "datadir-x", "part.1"), "wb") as f:
+        f.write(b"orphaned shard bytes")
+    if intent is not None:
+        with open(os.path.join(d, INTENT_FILE), "wb") as f:
+            f.write(json.dumps(intent).encode())
+    old = time.time() - age_s
+    for sub in (os.path.join(d, "datadir-x"), d):
+        os.utime(sub, (old, old))
+    return d
+
+
+def test_sweep_gcs_orphans_but_spares_young_stages(tmp_path):
+    eng = make_engine(tmp_path, n=4)
+    _no_worker(eng)
+    old = _stage_orphan(eng.disks[0].root, "dead-stage")
+    young = _stage_orphan(eng.disks[0].root, "live-stage", age_s=0.0)
+    report = sweep_engine(eng, age_s=60.0)
+    assert not os.path.exists(old), "past the age gate: GC'd"
+    assert os.path.exists(young), "age gate spares a live write"
+    assert report["found"] == 1 and report["cleaned"] == 1
+    assert report["requeued"] == []
+    assert eng.recovery_report is report
+    eng.shutdown()
+
+
+def test_sweep_requeues_partially_committed_object(tmp_path):
+    """The kill-after-write-quorum shape: the object committed on most
+    disks, one disk kept only its staging dir + intent. The sweep GCs
+    the stage and requeues the object; heal converges it."""
+    eng = make_engine(tmp_path, n=4)
+    _no_worker(eng)
+    eng.make_bucket("b")
+    body = os.urandom(40_000)
+    eng.put_object("b", "torn", body)
+    # Fake the crash: wipe ONE disk's copy and leave its stage behind.
+    victim = eng.disks[2].root
+    import shutil
+    shutil.rmtree(os.path.join(victim, "b", "torn"))
+    _stage_orphan(victim, "crashed-commit",
+                  intent={"bucket": "b", "object": "torn"})
+    report = sweep_engine(eng, age_s=60.0)
+    assert report["requeued"] == ["b/torn"]
+    assert eng.mrf.depth() == 1
+    # And heal actually restores full redundancy from the requeue.
+    eng.mrf.drain()
+    assert os.path.exists(os.path.join(victim, "b", "torn", "xl.meta"))
+    got, _ = eng.get_object("b", "torn")
+    assert got == body
+    eng.shutdown()
+
+
+def test_sweep_requeues_torn_overwrite_via_datadir_hint(tmp_path):
+    """A crash mid-OVERWRITE leaves every disk with SOME version (the
+    old one), so any-version presence reads 'fully present'. The
+    intent's dataDir makes the check version-aware: disks that missed
+    the new commit requeue."""
+    eng = make_engine(tmp_path, n=4)
+    _no_worker(eng)
+    eng.make_bucket("b")
+    eng.put_object("b", "ow", b"v1" * 5000)
+    new = os.urandom(30_000)
+    eng.put_object("b", "ow", new)
+    # Fake the torn overwrite on one disk: roll its xl.meta back to
+    # carrying only the OLD version's data dir.
+    victim = eng.disks[1].root
+    meta_path = os.path.join(victim, "b", "ow", "xl.meta")
+    doc = json.loads(open(meta_path).read())
+    new_dd = doc["versions"][0]["dataDir"]
+    import shutil
+    shutil.rmtree(os.path.join(victim, "b", "ow", new_dd))
+    doc["versions"][0]["dataDir"] = "0f0e0d0c-0000-4000-8000-00000000000f"
+    open(meta_path, "w").write(json.dumps(doc))
+    _stage_orphan(victim, "torn-overwrite",
+                  intent={"bucket": "b", "object": "ow",
+                          "dataDir": new_dd})
+    report = sweep_engine(eng, age_s=60.0)
+    assert report["requeued"] == ["b/ow"], report
+    eng.mrf.drain()
+    got, _ = eng.get_object("b", "ow")
+    assert got == new
+    eng.shutdown()
+
+
+def test_sweep_skips_requeue_for_uncommitted_and_fully_present(tmp_path):
+    eng = make_engine(tmp_path, n=4)
+    _no_worker(eng)
+    eng.make_bucket("b")
+    eng.put_object("b", "whole", b"x" * 1000)
+    # Fully present object: stage is garbage-collection residue only.
+    _stage_orphan(eng.disks[0].root, "gc-leftover",
+                  intent={"bucket": "b", "object": "whole"})
+    # Fully absent object: the write never committed anywhere.
+    _stage_orphan(eng.disks[1].root, "uncommitted",
+                  intent={"bucket": "b", "object": "never-was"})
+    report = sweep_engine(eng, age_s=60.0)
+    assert report["found"] == 2 and report["cleaned"] == 2
+    assert report["requeued"] == []
+    assert eng.mrf.depth() == 0
+    eng.shutdown()
+
+
+def test_sweep_gcs_torn_multipart_stage_files(tmp_path):
+    eng = make_engine(tmp_path, n=4)
+    _no_worker(eng)
+    root = eng.disks[0].root
+    base = os.path.join(root, ".minio.sys", "mpu", "hash", "upload-1")
+    os.makedirs(base, exist_ok=True)
+    stage = os.path.join(base, "part.1.deadbeef.stage")
+    keep = os.path.join(base, "part.1")
+    for p in (stage, keep):
+        with open(p, "wb") as f:
+            f.write(b"bytes")
+    old = time.time() - 120
+    os.utime(stage, (old, old))
+    os.utime(keep, (old, old))
+    report = sweep_engine(eng, age_s=60.0)
+    assert not os.path.exists(stage), "torn stage GC'd"
+    assert os.path.exists(keep), "committed part shard untouched"
+    assert report["stageFiles"] == 1
+    eng.shutdown()
+
+
+def test_put_stages_carry_intent_breadcrumbs(tmp_path, monkeypatch):
+    """The PUT staging dir contains intent.json while staged (pinned
+    by freezing the commit), and the commit removes the whole stage —
+    intent included."""
+    eng = make_engine(tmp_path, n=4)
+    _no_worker(eng)
+    eng.make_bucket("b")
+    seen = {}
+    orig = XLStorage.rename_data
+
+    def spy(self, src_volume, src_path, fi, dst_volume, dst_path):
+        stage = os.path.join(self.root, ".minio.sys", src_path)
+        ip = os.path.join(stage, INTENT_FILE)
+        if os.path.exists(ip):
+            seen[self.root] = json.loads(open(ip, "rb").read())
+        return orig(self, src_volume, src_path, fi, dst_volume,
+                    dst_path)
+
+    monkeypatch.setattr(XLStorage, "rename_data", spy)
+    eng.put_object("b", "k", os.urandom(30_000))
+    assert len(seen) == 4, "every disk's stage carried the breadcrumb"
+    assert all(d == {"bucket": "b", "object": "k", "versionId": "",
+                     "dataDir": next(iter(seen.values()))["dataDir"]}
+               for d in seen.values())
+    # And the commit consumed the stages (tmp empty on every disk).
+    for d in eng.disks:
+        assert os.listdir(os.path.join(d.root, ".minio.sys",
+                                       "tmp")) == []
+    eng.shutdown()

@@ -43,12 +43,13 @@ def _clean_state():
     FAULTS.clear()
 
 
-def S(t, cls="write", qps=0, errors=0, shed=0, slow=0, mrf=0, resets=0,
+def S(t, cls="write", qps=0, errors=0, shed=0, slow=0, mrf=0,
+      journal=0, resets=0,
       cache_h=0, cache_m=0, drives=None, backend=None):
     """One synthetic timeline sample (the delta shape tick() emits)."""
     return {"t": float(t), "qps": {cls: qps}, "errors": {cls: errors},
             "shed": {cls: shed}, "slow": {cls: slow},
-            "mrfDepth": mrf, "resets": resets,
+            "mrfDepth": mrf, "mrfJournal": journal, "resets": resets,
             "cacheHits": cache_h, "cacheMisses": cache_m,
             "drives": drives or {"suspect": 0, "faulty": 0,
                                  "quarantined": 0},
@@ -292,6 +293,24 @@ def test_backend_down_and_mrf_and_cache_rules():
     trs = wd2.tick(now=6.0, samples=growing)
     assert any(t["rule"] == "mrf_backlog" and t["new"] == "firing"
                for t in trs)
+    # Recovery backlog (the durable-queue twin): monotone growth of
+    # the MRF journal backlog to >= MIN_DEPTH over GROW_TICKS; a flat
+    # (even large) backlog stays quiet — a big-but-draining journal is
+    # heal doing its job, growth is the non-convergence signal.
+    wd_r = make_wd(pending_ticks=1)
+    flat_j = [S(t, qps=1, journal=30) for t in range(6)]
+    assert not any(t["rule"] == "recovery_backlog"
+                   for t in wd_r.tick(now=6.0, samples=flat_j))
+    growing_j = [S(t, qps=1, journal=3 * t) for t in range(6)]
+    trs = wd_r.tick(now=6.0, samples=growing_j)
+    assert any(t["rule"] == "recovery_backlog"
+               and t["new"] == "firing" and "journal" in t["cause"]
+               for t in trs)
+    # Below MIN_DEPTH growth never fires (1-2-3 entries is noise).
+    wd_s = make_wd(pending_ticks=1)
+    small = [S(t, qps=1, journal=t) for t in range(6)]
+    assert not any(t["rule"] == "recovery_backlog"
+                   for t in wd_s.tick(now=6.0, samples=small))
     # Cache collapse: healthy slow-window ratio, collapsed fast one.
     wd3 = make_wd(fast_s=5.0, slow_s=60.0, pending_ticks=1)
     history = [S(t, qps=1, cache_h=90, cache_m=10)

@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+from .commits import CommitReplaceRule
 from .concurrency import ThreadCtxRule
 from .errormap import ErrorMapRule
 from .kernels import KernelPurityRule
@@ -22,6 +23,7 @@ def all_rules():
         KernelPurityRule(),
         ErrorMapRule(),
         BoundedRetryRule(),
+        CommitReplaceRule(),
         NativeAssertRule(),
         MetricNameRule(),
         QosMetricCallRule(),

@@ -1,0 +1,45 @@
+"""R7 — storage-layer renames must go through the blessed commit
+helper.
+
+The crash-consistency PR centralizes every commit-path rename in
+``minio_tpu/storage/xl.py::commit_replace`` — the one choke point
+where the ``storage fsync=on`` durability policy (fsync source +
+destination parent dir) is applied, and where any future
+commit-ordering change lands once instead of being hand-synced across
+N call sites. A raw ``os.replace``/``os.rename`` added anywhere under
+``minio_tpu/storage/`` silently bypasses that policy: the write LOOKS
+committed but never fsyncs, which is precisely the class of bug that
+only shows up as lost data after a power cut — undetectable by every
+test that doesn't yank the cord.
+
+The helper's own ``os.replace`` carries a justified suppression (the
+waiver doubles as the pointer to the policy seam). ``shutil.move`` and
+friends are not flagged — they do not appear on commit paths here, and
+widening the net to every file op would bury the signal.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, dotted_name
+
+
+class CommitReplaceRule(Rule):
+    id = "R7"
+    title = ("os.replace/os.rename in minio_tpu/storage/ must route "
+             "through the blessed commit helper (xl.commit_replace)")
+
+    def applies(self, ctx) -> bool:
+        return ctx.relpath.startswith("minio_tpu/storage/")
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = dotted_name(node.func)
+        if name in ("os.replace", "os.rename"):
+            self.flag(node, (
+                f"raw {name} on a storage path — route the rename "
+                "through storage/xl.py commit_replace so the fsync "
+                "commit policy (and future ordering changes) apply; "
+                "a justified '# mtpu-lint: disable=R7' waiver is the "
+                "escape hatch for genuinely non-commit renames"))
+        self.generic_visit(node)
