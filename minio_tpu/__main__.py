@@ -116,11 +116,19 @@ def _announce(msg: str, access: str) -> None:
 
 
 def _wait_for_sigterm() -> None:
-    stop = []
-    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    # An Event + timed wait, NOT signal.pause(): the kernel delivers a
+    # process-directed SIGTERM to ANY thread with it unblocked, and
+    # pause() only returns when THIS thread takes a signal — with the
+    # front door's loop/worker threads in the mix, a SIGTERM landing
+    # on one of them left the main thread paused forever (~1-in-3).
+    # The Python-level handler always runs on the main thread; the
+    # timed wait guarantees a bytecode boundary for it soon after.
+    import threading
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
     try:
-        while not stop:
-            signal.pause()
+        while not stop.wait(1.0):
+            pass
     except KeyboardInterrupt:
         pass
 

@@ -454,3 +454,18 @@ METRICS2.register(
 METRICS2.register(
     "minio_tpu_v2_incidents_total", "counter",
     "Incident bundles frozen by firing alerts, by rule.")
+METRICS2.register(
+    "minio_tpu_v2_open_connections", "gauge",
+    "Client connections currently held by the front door "
+    "(keep-alive sockets, idle or active).")
+METRICS2.register(
+    "minio_tpu_v2_accept_queue_depth", "gauge",
+    "Connections accepted but not yet established (TLS handshake / "
+    "loop handoff in flight).")
+METRICS2.register(
+    "minio_tpu_v2_connections_accepted_total", "counter",
+    "Client connections accepted by the front door.")
+METRICS2.register(
+    "minio_tpu_v2_conn_parse_errors_total", "counter",
+    "Connections rejected at the HTTP framing layer (malformed head, "
+    "oversized head, bad Content-Length, failed TLS handshake).")

@@ -143,6 +143,17 @@ class Span:
         self.finish()
         return False
 
+    def detach_context(self) -> None:
+        """Reset the contextvar token WITHOUT finishing the span — for
+        handoff points where the entering thread returns to a pool
+        while the span stays open (the async front door's streaming
+        responses: the drain task carries the span in a copied context
+        and calls finish() later, from a context where resetting the
+        original token would be illegal)."""
+        if self._token is not None:
+            _current.reset(self._token)
+            self._token = None
+
     def finish(self) -> dict | None:
         """Close the span; for a ROOT span returns the completed trace
         tree (and lands it in the tracer's ring)."""

@@ -231,6 +231,14 @@ class Timeline:
             "cacheFills": _series_sum(
                 m("minio_tpu_v2_cache_fills_total")),
             "cacheBytes": _series_sum(m("minio_tpu_v2_cache_bytes")),
+            # Connection plane (s3/asyncserver.py): open keep-alive
+            # sockets + accept backlog are gauges, parse rejections a
+            # counter the tick deltas.
+            "conns": _series_sum(m("minio_tpu_v2_open_connections")),
+            "acceptQueue": _series_sum(
+                m("minio_tpu_v2_accept_queue_depth")),
+            "parseErrors": _series_sum(
+                m("minio_tpu_v2_conn_parse_errors_total")),
             "mrfDepth": _series_sum(m("minio_tpu_v2_mrf_queue_depth")),
             # Durable-queue twin of mrfDepth: live entries in the
             # per-set MRF journal (watchdog recovery_backlog watches
@@ -313,6 +321,10 @@ class Timeline:
                 "cacheFills": _d(raw.get("cacheFills", 0),
                                  prev.get("cacheFills", 0)),
                 "cacheBytes": raw.get("cacheBytes", 0),
+                "conns": raw.get("conns", 0),
+                "acceptQueue": raw.get("acceptQueue", 0),
+                "parseErrors": _d(raw.get("parseErrors", 0),
+                                  prev.get("parseErrors", 0)),
                 "mrfDepth": raw["mrfDepth"],
                 "mrfJournal": raw.get("mrfJournal", 0),
                 "drives": dict(raw["drives"]),
@@ -410,6 +422,9 @@ def _collapse_node(snap: dict, period_s: float) -> list[dict]:
             "rx": 0, "tx": 0, "hedgeFired": 0, "resets": 0,
             "cacheHits": 0, "cacheMisses": 0, "cacheFills": 0,
             "cacheBytes": last.get("cacheBytes", 0),
+            "conns": last.get("conns", 0),
+            "acceptQueue": last.get("acceptQueue", 0),
+            "parseErrors": 0,
             "mrfDepth": last.get("mrfDepth", 0),
             "mrfJournal": last.get("mrfJournal", 0),
             "drives": dict(last.get("drives") or {}),
@@ -423,7 +438,8 @@ def _collapse_node(snap: dict, period_s: float) -> list[dict]:
                 for k, v in (s.get(fld) or {}).items():
                     c[fld][k] = c[fld].get(k, 0) + v
             for fld in ("rx", "tx", "hedgeFired", "cacheHits",
-                        "cacheMisses", "cacheFills", "resets"):
+                        "cacheMisses", "cacheFills", "resets",
+                        "parseErrors"):
                 c[fld] += s.get(fld, 0)
             for k, v in (s.get("backendState") or {}).items():
                 c["backendState"][k] = max(c["backendState"].get(k, 0),
@@ -469,6 +485,7 @@ def merge_timelines(snapshots: list[dict],
                     "queueDepth": 0, "rx": 0, "tx": 0,
                     "kernelBytes": {}, "kernelGiBs": {},
                     "hedgeFired": 0, "mrfDepth": 0, "mrfJournal": 0,
+                    "conns": 0, "acceptQueue": 0, "parseErrors": 0,
                     "resets": 0,
                     "cacheHits": 0, "cacheMisses": 0,
                     "cacheFills": 0, "cacheBytes": 0,
@@ -486,6 +503,7 @@ def merge_timelines(snapshots: list[dict],
             for fld in ("queueDepth", "rx", "tx", "hedgeFired",
                         "mrfDepth", "mrfJournal", "cacheHits",
                         "cacheMisses", "cacheFills", "cacheBytes",
+                        "conns", "acceptQueue", "parseErrors",
                         "resets"):
                 cur[fld] += s.get(fld, 0)
             for k, v in (s.get("drives") or {}).items():

@@ -120,8 +120,14 @@ class RPCClient:
         self.cluster_key = cluster_key
         self.tls = tls
         # Self-tuning timeout: slow peers stretch it, fast ones shrink
-        # it back (ref cmd/dynamic-timeouts.go:35).
-        self.dyn_timeout = DynamicTimeout(timeout, minimum=1.0)
+        # it back (ref cmd/dynamic-timeouts.go:35). The floor is 2.5s,
+        # not the reference's 1s: a peer served by the event-loop
+        # front door answers through loop→worker→loop hops whose tail
+        # under CPU contention is scheduling-bound, and a spurious
+        # sub-second timeout here MARKS THE PEER OFFLINE — one blip
+        # then degrades every write to that node for OFFLINE_RETRY,
+        # which is how a momentarily-busy box turns into MRF backlog.
+        self.dyn_timeout = DynamicTimeout(timeout, minimum=2.5)
         self._offline_until = 0.0
         self._mu = threading.Lock()
         self._pool: list[http.client.HTTPConnection] = []

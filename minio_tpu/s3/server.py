@@ -2190,6 +2190,7 @@ class S3Server:
         self.stream_threshold = 8 * 1024 * 1024
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
+        self._front_door = None  # asyncserver.AsyncFrontDoor when async
 
     @property
     def layer(self):
@@ -3531,14 +3532,390 @@ class S3Server:
         return S3Response(200, root.tobytes(),
                           {"Content-Type": "application/xml"})
 
+    # ---------------- request core (transport-agnostic) ----------------
+
+    def preflight(self, raw_path: str, headers: dict,
+                  ) -> tuple[int, list]:
+        """CORS preflight decision, shared by the threaded handler's
+        do_OPTIONS and the async front door (unauthenticated by
+        design; ref the preflight path of the CORS middleware).
+        Returns (status, response headers)."""
+        origin = headers.get("origin", "")
+        want = headers.get("access-control-request-method", "")
+        want_headers = [
+            x.strip().lower() for x in headers.get(
+                "access-control-request-headers", ""
+            ).split(",") if x.strip()]
+        bucket = raw_path.lstrip("/").split("/", 1)[0]
+        rule = None
+        if bucket and self.handlers is not None:
+            rule = self.handlers.cors_match(bucket, origin, want)
+        if rule is not None and want_headers:
+            allowed = rule["headers"]
+            if "*" not in allowed and any(
+                    hh not in allowed for hh in want_headers):
+                rule = None  # requested header not allowed
+        if rule is None:
+            return 403, [("Content-Length", "0")]
+        out = [("Access-Control-Allow-Origin", origin),
+               ("Access-Control-Allow-Methods",
+                ", ".join(rule["methods"]))]
+        if rule["headers"]:
+            out.append(("Access-Control-Allow-Headers",
+                        ", ".join(rule["headers"])))
+        if rule["max_age"]:
+            out.append(("Access-Control-Max-Age", rule["max_age"]))
+        out.append(("Content-Length", "0"))
+        return 200, out
+
+    def _serve_one(self, txn) -> None:
+        """One request's full lifecycle over an abstract transport
+        (`txn`): routing, QoS boundary, trace root, accounting,
+        response framing.  Both front ends — the threaded handler
+        (`_ThreadedTxn`) and the async event loop (`asyncserver`'s
+        `_AsyncTxn`) — drive requests through THIS method, so the
+        semantics at the QoS/trace/metrics boundary cannot drift
+        between them.  Runs on a handler thread (threaded) or a
+        worker-pool thread (async)."""
+        server = self
+        t0 = time.monotonic()
+        root_span = None
+        finish_fn = None
+        detached = False
+        command, raw_path, query = txn.command, txn.raw_path, txn.query
+        headers, body, length = txn.headers, txn.body, txn.rx_length
+        try:
+            if command == "OPTIONS":
+                status, hdrs = self.preflight(raw_path, headers)
+                txn.send_head(status, hdrs)
+                return
+            # Internal cluster RPC rides the same port
+            # (ref registerDistErasureRouters, cmd/routers.go:26).
+            if server.rpc_registry is not None and \
+                    raw_path.startswith("/minio-tpu/rpc/"):
+                status, rhdrs, rbody = server.rpc_registry.handle(
+                    raw_path, headers, body)
+                out = list(rhdrs.items())
+                out.append(("Content-Length", str(len(rbody))))
+                txn.send_head(status, out)
+                txn.write(rbody)
+                return
+            # Health, metrics, admin (ref healthcheck-router.go,
+            # metrics-router.go, admin-router.go).
+            if raw_path.startswith("/minio-tpu/"):
+                res = server.handle_ops(command, raw_path, query,
+                                        headers, body)
+                status, ctype, rbody = res[:3]
+                out = [("Content-Type", ctype)]
+                out.extend((res[3] if len(res) > 3 else {}).items())
+                out.append(("Content-Length", str(len(rbody))))
+                txn.send_head(status, out)
+                txn.write(rbody)
+                return
+            req = S3Request(command, raw_path, query, headers, body)
+            if txn.body_stream is not None:
+                req.body_stream = txn.body_stream
+                req.content_length = txn.content_length
+            # Root span of this request's trace, keyed by the
+            # x-amz-request-id the response already carries —
+            # every layer below (engine, kernels, disks, peer
+            # RPC) hangs child spans off it via the contextvar.
+            from ..obs.span import TRACER
+            root_span = TRACER.begin(
+                "s3.request", req.request_id,
+                method=command, path=raw_path)
+            if root_span is not None:
+                root_span.__enter__()
+            try:
+                resp = server.route_qos(req)
+            except APIError as e:
+                resp = None
+                if getattr(e, "code", "") == "NoSuchBucket":
+                    resp = server._federation_redirect(req)
+                if resp is None:
+                    hdrs = {"Content-Type": "application/xml"}
+                    hdrs.update(e.headers())
+                    resp = S3Response(
+                        e.http_status,
+                        e.xml(raw_path, req.request_id),
+                        hdrs)
+            except (QuorumError, TimeoutError) as e:
+                # Quorum races/outages and lock-acquire
+                # timeouts are RETRYABLE: 503 SlowDown,
+                # matching the reference's
+                # InsufficientWriteQuorum/OperationTimedOut ->
+                # ErrSlowDown (cmd/api-errors.go:1898). Clients
+                # with standard retry policies recover
+                # transparently. A burnt request DEADLINE is
+                # the same family but its own code: 503
+                # RequestTimeout (ref ErrOperationTimedOut).
+                from ..logger import Logger
+                from ..qos.deadline import DeadlineExceeded
+                Logger.get().log_once(
+                    f"{command} {raw_path}: quorum: {e}",
+                    "s3-handler")
+                if isinstance(e, DeadlineExceeded):
+                    # Burnt budget = deliberate backpressure,
+                    # exempt from slowlog like admission sheds.
+                    req.slowlog_exempt = True
+                err = (s3err.ERR_REQUEST_TIMEOUT
+                       if isinstance(e, DeadlineExceeded)
+                       else s3err.ERR_SLOW_DOWN
+                       ).with_retry_after(1)
+                resp = S3Response(
+                    err.http_status,
+                    err.xml(raw_path, req.request_id),
+                    {"Content-Type": "application/xml",
+                     **err.headers()})
+            except Exception as e:  # noqa: BLE001
+                if isinstance(e, APIError):
+                    raise
+                from ..logger import Logger
+                Logger.get().log_once(
+                    f"{command} {raw_path}: "
+                    f"{type(e).__name__}: {e}", "s3-handler")
+                # A raw per-disk storage error that escaped the
+                # engine's quorum reduction still answers its
+                # TYPED S3 code (404/409/503/507) instead of an
+                # opaque 500 — STORAGE_ERROR_MAP is kept total
+                # by lint rule R5.
+                err = (s3err.storage_api_error(e)
+                       or s3err.ERR_INTERNAL_ERROR)
+                resp = S3Response(
+                    err.http_status,
+                    err.xml(raw_path, req.request_id),
+                    {"Content-Type": "application/xml",
+                     **err.headers()})
+            api = (f"{command}-"
+                   f"{'object' if req.key else 'bucket' if req.bucket else 'service'}")
+            body_is_stream = not isinstance(
+                resp.body, (bytes, bytearray))
+            trace_tree = None
+            if root_span is not None:
+                root_span.name = api
+                root_span.tags["statusCode"] = resp.status
+                if not body_is_stream or command == "HEAD":
+                    # Buffered response: close BEFORE further
+                    # socket work so the thread's span context
+                    # never leaks into the next keep-alive
+                    # request. STREAMING responses keep the
+                    # root open — the engine's per-group shard
+                    # reads run lazily while the body writes
+                    # below, and must still attach; the
+                    # _finish_request finally closes it.
+                    trace_tree = root_span.finish()
+            # Keep-alive hygiene: whatever the handler left unread
+            # (auth failures, sheds, burnt deadlines, early errors)
+            # must not desync the next pipelined request. Policy is
+            # the transport's: threaded drains the remainder inline;
+            # async discards small tails loop-side and CLOSES past its
+            # cap (or when an Expect body was never solicited), per
+            # Content-Length. close_hdr = the response must carry
+            # `Connection: close` so the client knows.
+            close_hdr = txn.prepare_body_cleanup()
+            resp_len = (int(resp.headers.get("Content-Length", 0))
+                        if body_is_stream else len(resp.body))
+
+            # Atomic once-guard: on the async path the teardown safety
+            # net and the drain task's cleanup can (in pathological
+            # interleavings) both reach this from different pool
+            # threads — a bare flag's check-then-set window would
+            # account the request twice and double-release its slot.
+            _fin_mu = threading.Lock()
+            _finished = [False]
+
+            def _finish_request():
+                nonlocal trace_tree
+                with _fin_mu:
+                    if _finished[0]:
+                        return
+                    _finished[0] = True
+                qos_release = getattr(resp, "qos_release", None)
+                if qos_release is not None:
+                    qos_release()  # streaming body done: free
+                if root_span is not None and trace_tree is None:
+                    trace_tree = root_span.finish()
+                dur_ms = (time.monotonic() - t0) * 1000.0
+                server.metrics.record(api, resp.status, length,
+                                      resp_len)
+                from ..obs.metrics2 import METRICS2
+                METRICS2.inc("minio_tpu_v2_api_requests_total",
+                             {"api": api,
+                              "status": resp.status})
+                if resp.status >= 500 \
+                        and not req.slowlog_exempt:
+                    # Per-CLASS 5xx counter: the watchdog's
+                    # error-burn numerator (api_requests_total
+                    # has per-API status detail but no class).
+                    # Sheds/burnt deadlines are EXEMPT like in
+                    # the slowlog: deliberate backpressure is
+                    # the shed-burn rule's signal, and letting
+                    # it bleed into error-burn would page twice
+                    # for one brownout.
+                    METRICS2.inc(
+                        "minio_tpu_v2_api_class_errors_total",
+                        {"class": req.qos_class or "read"})
+                METRICS2.observe(
+                    "minio_tpu_v2_api_request_duration_ms",
+                    {"api": api}, dur_ms)
+                if length:
+                    METRICS2.inc(
+                        "minio_tpu_v2_api_rx_bytes_total",
+                        None, length)
+                if resp_len:
+                    METRICS2.inc(
+                        "minio_tpu_v2_api_tx_bytes_total",
+                        None, resp_len)
+                server.bandwidth.record(req.bucket, length,
+                                        resp_len)
+                # Slow-request capture: over-SLO or 5xx lands
+                # the full span tree + QoS data in the slowlog
+                # ring, annotated with the blamed layer
+                # (obs/slowlog.py). Sheds/burnt deadlines are
+                # exempt (deliberate backpressure).
+                # Worst-request exemplar for the current
+                # timeline window: a spike in the 1s series
+                # links straight to this request's trace tree
+                # (and its slowlog entry when captured).
+                from ..obs.timeline import TIMELINE
+                TIMELINE.note_request(req.qos_class, dur_ms,
+                                      req.request_id)
+                from ..obs.slowlog import SLOWLOG
+                slow_entry = SLOWLOG.record(
+                    api=api, api_class=req.qos_class,
+                    method=command, path=raw_path,
+                    status=resp.status, duration_ms=dur_ms,
+                    request_id=req.request_id,
+                    trace=trace_tree,
+                    qos={"class": req.qos_class,
+                         "waitMs": round(req.qos_wait_ms, 3),
+                         "deadlineS": req.qos_deadline_s},
+                    exempt=req.slowlog_exempt)
+                server.publish_trace(
+                    api, command, raw_path, resp.status,
+                    dur_ms, length,
+                    resp_len, req.request_id,
+                    txn.client_ip,
+                    getattr(req, "access_key", ""),
+                    spans=trace_tree,
+                    qos_class=req.qos_class,
+                    blamed_layer=(slow_entry["blamedLayer"]
+                                  if slow_entry else ""))
+
+            finish_fn = _finish_request
+            if not body_is_stream:
+                # Buffered: account/publish before the write,
+                # as before (the body cannot fail mid-flight).
+                _finish_request()
+            hdrs_out = [("x-amz-request-id", req.request_id),
+                        ("Server", "MinIO-TPU")]
+            origin = headers.get("origin", "")
+            if origin and req.bucket and \
+                    server.handlers is not None:
+                rule = server.handlers.cors_match(
+                    req.bucket, origin, command)
+                if rule is not None:
+                    hdrs_out.append(
+                        ("Access-Control-Allow-Origin", origin))
+                    if rule["expose"]:
+                        hdrs_out.append(
+                            ("Access-Control-Expose-Headers",
+                             ", ".join(rule["expose"])))
+            for k, v in resp.headers.items():
+                hdrs_out.append((k, v))
+            if "Content-Length" not in resp.headers:
+                hdrs_out.append(("Content-Length", str(resp_len)))
+            if close_hdr:
+                hdrs_out.append(("Connection", "close"))
+            txn.send_head(resp.status, hdrs_out)
+            if command == "HEAD":
+                pass
+            elif body_is_stream:
+                # Streaming GET: blocks flow decoded-chunk by
+                # decoded-chunk from the engine to the socket.
+                # Mid-stream decode/auth failures (bitrot,
+                # compression damage, GCM auth) arrive AFTER the
+                # 200 headers went out — the transport aborts the
+                # connection so the client sees a short body, never
+                # a clean success. The threaded transport drives
+                # the body inline; the async one DETACHES (returns
+                # True) and its loop pulls chunks, owning finish_fn
+                # from here.
+                detached = txn.stream_response(resp, raw_path,
+                                               _finish_request,
+                                               root_span)
+            elif resp.body:
+                txn.write(resp.body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            # Safety nets (both idempotent): a streaming
+            # response whose client vanished before/while the
+            # body wrote still gets its metrics/trace
+            # accounted, and an open span context never leaks
+            # into the next keep-alive request on this thread.
+            # A DETACHED response hands both duties to the async
+            # drain task (backstopped by connection teardown).
+            if not detached:
+                if finish_fn is not None:
+                    finish_fn()
+                if root_span is not None:
+                    root_span.finish()
+
     # ---------------- HTTP plumbing ----------------
 
     def start(self, host: str = "127.0.0.1", port: int = 0,
               cert_manager=None) -> int:
-        """cert_manager: utils.certs.CertManager for HTTPS with
-        hot-reloaded certificates (None = plaintext HTTP)."""
-        server = self
+        """Boot the front door. Default is the asyncio event-loop
+        listener (`s3/asyncserver.py`): accept/parse/keep-alive for
+        10k+ sockets on a handful of loop threads, request execution
+        on a bounded worker pool through the same `_serve_one` core.
+        `MINIO_FRONT_DOOR=threaded` keeps the legacy thread-per-
+        connection front end. cert_manager: utils.certs.CertManager
+        for HTTPS with hot-reloaded certificates (None = plaintext)."""
+        import os as _os
         self.cert_manager = cert_manager
+        mode = _os.environ.get("MINIO_FRONT_DOOR",
+                               "async").strip().lower()
+        if mode == "threaded":
+            bound = self._start_threaded(host, port, cert_manager)
+        else:
+            from .asyncserver import AsyncFrontDoor
+            front = AsyncFrontDoor(self, cert_manager=cert_manager)
+            try:
+                bound = front.start(host, port)
+            except BaseException:
+                front.pool.shutdown(wait=False)
+                front.rpc_pool.shutdown(wait=False)
+                front.stream_pool.shutdown(wait=False)
+                raise
+            self._front_door = front
+            # Address shim: callers (webrpc port probe, tests) read
+            # `server._httpd.server_address` regardless of front end.
+            self._httpd = _BoundAddress(host, bound)
+        # Timeline sampler: one process-wide daemon deltaing the
+        # registry per sample period (refcounted — the last server to
+        # stop stops it; its tick also drives kernprof's rate-limited
+        # backend recovery probes).
+        from ..obs.timeline import TIMELINE
+        TIMELINE.start()
+        self._timeline_started = True
+        # Incident bundles capture server-scoped context (effective
+        # config, MRF census) through providers — the recorder itself
+        # stays server-agnostic.
+        from ..obs.incidents import INCIDENTS
+        INCIDENTS.providers["config"] = self._incident_config
+        INCIDENTS.providers["mrf"] = self._mrf_stats
+        if cert_manager is not None:
+            cert_manager.start()
+        return bound
+
+    def _start_threaded(self, host: str, port: int,
+                        cert_manager) -> int:
+        """The legacy thread-per-connection front end
+        (MINIO_FRONT_DOOR=threaded): one OS thread per socket,
+        BaseHTTPRequestHandler framing, same `_serve_one` core."""
+        server = self
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -3552,13 +3929,11 @@ class S3Server:
                 pass
 
             def _handle(self):
-                t0 = time.monotonic()
-                root_span = None
-                finish_fn = None
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     raw_path, _, query = self.path.partition("?")
-                    headers = {k.lower(): v for k, v in self.headers.items()}
+                    headers = {k.lower(): v
+                               for k, v in self.headers.items()}
                     # Large object PUTs stream: the socket body is never
                     # buffered whole (ref the reference's streaming PUT
                     # pipeline, cmd/erasure-encode.go:73).
@@ -3574,329 +3949,21 @@ class S3Server:
                     else:
                         body = self.rfile.read(length) if length else b""
                         body_stream = None
-                    # Internal cluster RPC rides the same port
-                    # (ref registerDistErasureRouters, cmd/routers.go:26).
-                    if server.rpc_registry is not None and \
-                            raw_path.startswith("/minio-tpu/rpc/"):
-                        status, rhdrs, rbody = server.rpc_registry.handle(
-                            raw_path, headers, body)
-                        self.send_response(status)
-                        for k, v in rhdrs.items():
-                            self.send_header(k, v)
-                        self.send_header("Content-Length", str(len(rbody)))
-                        self.end_headers()
-                        if rbody:
-                            self.wfile.write(rbody)
-                        return
-                    # Health, metrics, admin (ref healthcheck-router.go,
-                    # metrics-router.go, admin-router.go).
-                    if raw_path.startswith("/minio-tpu/"):
-                        res = server.handle_ops(
-                            self.command, raw_path, query, headers, body)
-                        status, ctype, rbody = res[:3]
-                        self.send_response(status)
-                        self.send_header("Content-Type", ctype)
-                        for hk, hv in (res[3] if len(res) > 3
-                                       else {}).items():
-                            self.send_header(hk, hv)
-                        self.send_header("Content-Length", str(len(rbody)))
-                        self.end_headers()
-                        if rbody:
-                            self.wfile.write(rbody)
-                        return
-                    req = S3Request(self.command, raw_path, query, headers,
-                                    body)
-                    if body_stream is not None:
-                        req.body_stream = body_stream
-                        req.content_length = length
-                    # Root span of this request's trace, keyed by the
-                    # x-amz-request-id the response already carries —
-                    # every layer below (engine, kernels, disks, peer
-                    # RPC) hangs child spans off it via the contextvar.
-                    from ..obs.span import TRACER
-                    root_span = TRACER.begin(
-                        "s3.request", req.request_id,
-                        method=self.command, path=raw_path)
-                    if root_span is not None:
-                        root_span.__enter__()
-                    try:
-                        resp = server.route_qos(req)
-                    except APIError as e:
-                        resp = None
-                        if getattr(e, "code", "") == "NoSuchBucket":
-                            resp = server._federation_redirect(req)
-                        if resp is None:
-                            hdrs = {"Content-Type": "application/xml"}
-                            hdrs.update(e.headers())
-                            resp = S3Response(
-                                e.http_status,
-                                e.xml(raw_path, req.request_id),
-                                hdrs)
-                    except (QuorumError, TimeoutError) as e:
-                        # Quorum races/outages and lock-acquire
-                        # timeouts are RETRYABLE: 503 SlowDown,
-                        # matching the reference's
-                        # InsufficientWriteQuorum/OperationTimedOut ->
-                        # ErrSlowDown (cmd/api-errors.go:1898). Clients
-                        # with standard retry policies recover
-                        # transparently. A burnt request DEADLINE is
-                        # the same family but its own code: 503
-                        # RequestTimeout (ref ErrOperationTimedOut).
-                        from ..logger import Logger
-                        from ..qos.deadline import DeadlineExceeded
-                        Logger.get().log_once(
-                            f"{self.command} {raw_path}: quorum: {e}",
-                            "s3-handler")
-                        if isinstance(e, DeadlineExceeded):
-                            # Burnt budget = deliberate backpressure,
-                            # exempt from slowlog like admission sheds.
-                            req.slowlog_exempt = True
-                        err = (s3err.ERR_REQUEST_TIMEOUT
-                               if isinstance(e, DeadlineExceeded)
-                               else s3err.ERR_SLOW_DOWN
-                               ).with_retry_after(1)
-                        resp = S3Response(
-                            err.http_status,
-                            err.xml(raw_path, req.request_id),
-                            {"Content-Type": "application/xml",
-                             **err.headers()})
-                    except Exception as e:  # noqa: BLE001
-                        if isinstance(e, APIError):
-                            raise
-                        from ..logger import Logger
-                        Logger.get().log_once(
-                            f"{self.command} {raw_path}: "
-                            f"{type(e).__name__}: {e}", "s3-handler")
-                        # A raw per-disk storage error that escaped the
-                        # engine's quorum reduction still answers its
-                        # TYPED S3 code (404/409/503/507) instead of an
-                        # opaque 500 — STORAGE_ERROR_MAP is kept total
-                        # by lint rule R5.
-                        err = (s3err.storage_api_error(e)
-                               or s3err.ERR_INTERNAL_ERROR)
-                        resp = S3Response(
-                            err.http_status,
-                            err.xml(raw_path, req.request_id),
-                            {"Content-Type": "application/xml",
-                             **err.headers()})
-                    api = (f"{self.command}-"
-                           f"{'object' if req.key else 'bucket' if req.bucket else 'service'}")
-                    body_is_stream = not isinstance(
-                        resp.body, (bytes, bytearray))
-                    trace_tree = None
-                    if root_span is not None:
-                        root_span.name = api
-                        root_span.tags["statusCode"] = resp.status
-                        if not body_is_stream or self.command == "HEAD":
-                            # Buffered response: close BEFORE further
-                            # socket work so the thread's span context
-                            # never leaks into the next keep-alive
-                            # request. STREAMING responses keep the
-                            # root open — the engine's per-group shard
-                            # reads run lazily while the body writes
-                            # below, and must still attach; the
-                            # _finish_request finally closes it.
-                            trace_tree = root_span.finish()
-                    if body_stream is not None:
-                        # Keep-alive hygiene: whatever the handler left
-                        # unread (auth failures, early errors) must be
-                        # drained before the next request parses.
-                        while body_stream.read(64 * 1024):
-                            pass
-                    resp_len = (int(resp.headers.get("Content-Length", 0))
-                                if body_is_stream else len(resp.body))
-
-                    _finished = [False]
-
-                    def _finish_request():
-                        nonlocal trace_tree
-                        if _finished[0]:
-                            return
-                        _finished[0] = True
-                        qos_release = getattr(resp, "qos_release", None)
-                        if qos_release is not None:
-                            qos_release()  # streaming body done: free
-                        if root_span is not None and trace_tree is None:
-                            trace_tree = root_span.finish()
-                        dur_ms = (time.monotonic() - t0) * 1000.0
-                        server.metrics.record(api, resp.status, length,
-                                              resp_len)
-                        from ..obs.metrics2 import METRICS2
-                        METRICS2.inc("minio_tpu_v2_api_requests_total",
-                                     {"api": api,
-                                      "status": resp.status})
-                        if resp.status >= 500 \
-                                and not req.slowlog_exempt:
-                            # Per-CLASS 5xx counter: the watchdog's
-                            # error-burn numerator (api_requests_total
-                            # has per-API status detail but no class).
-                            # Sheds/burnt deadlines are EXEMPT like in
-                            # the slowlog: deliberate backpressure is
-                            # the shed-burn rule's signal, and letting
-                            # it bleed into error-burn would page twice
-                            # for one brownout.
-                            METRICS2.inc(
-                                "minio_tpu_v2_api_class_errors_total",
-                                {"class": req.qos_class or "read"})
-                        METRICS2.observe(
-                            "minio_tpu_v2_api_request_duration_ms",
-                            {"api": api}, dur_ms)
-                        if length:
-                            METRICS2.inc(
-                                "minio_tpu_v2_api_rx_bytes_total",
-                                None, length)
-                        if resp_len:
-                            METRICS2.inc(
-                                "minio_tpu_v2_api_tx_bytes_total",
-                                None, resp_len)
-                        server.bandwidth.record(req.bucket, length,
-                                                resp_len)
-                        # Slow-request capture: over-SLO or 5xx lands
-                        # the full span tree + QoS data in the slowlog
-                        # ring, annotated with the blamed layer
-                        # (obs/slowlog.py). Sheds/burnt deadlines are
-                        # exempt (deliberate backpressure).
-                        # Worst-request exemplar for the current
-                        # timeline window: a spike in the 1s series
-                        # links straight to this request's trace tree
-                        # (and its slowlog entry when captured).
-                        from ..obs.timeline import TIMELINE
-                        TIMELINE.note_request(req.qos_class, dur_ms,
-                                              req.request_id)
-                        from ..obs.slowlog import SLOWLOG
-                        slow_entry = SLOWLOG.record(
-                            api=api, api_class=req.qos_class,
-                            method=self.command, path=raw_path,
-                            status=resp.status, duration_ms=dur_ms,
-                            request_id=req.request_id,
-                            trace=trace_tree,
-                            qos={"class": req.qos_class,
-                                 "waitMs": round(req.qos_wait_ms, 3),
-                                 "deadlineS": req.qos_deadline_s},
-                            exempt=req.slowlog_exempt)
-                        server.publish_trace(
-                            api, self.command, raw_path, resp.status,
-                            dur_ms, length,
-                            resp_len, req.request_id,
-                            self.client_address[0],
-                            getattr(req, "access_key", ""),
-                            spans=trace_tree,
-                            qos_class=req.qos_class,
-                            blamed_layer=(slow_entry["blamedLayer"]
-                                          if slow_entry else ""))
-
-                    finish_fn = _finish_request
-                    if not body_is_stream:
-                        # Buffered: account/publish before the write,
-                        # as before (the body cannot fail mid-flight).
-                        _finish_request()
-                    self.send_response(resp.status)
-                    self.send_header("x-amz-request-id", req.request_id)
-                    self.send_header("Server", "MinIO-TPU")
-                    origin = headers.get("origin", "")
-                    if origin and req.bucket and \
-                            server.handlers is not None:
-                        rule = server.handlers.cors_match(
-                            req.bucket, origin, self.command)
-                        if rule is not None:
-                            self.send_header(
-                                "Access-Control-Allow-Origin", origin)
-                            if rule["expose"]:
-                                self.send_header(
-                                    "Access-Control-Expose-Headers",
-                                    ", ".join(rule["expose"]))
-                    for k, v in resp.headers.items():
-                        self.send_header(k, v)
-                    if "Content-Length" not in resp.headers:
-                        self.send_header("Content-Length",
-                                         str(resp_len))
-                    self.end_headers()
-                    if self.command == "HEAD":
-                        pass
-                    elif body_is_stream:
-                        # Streaming GET: blocks flow decoded-chunk by
-                        # decoded-chunk from the engine to the socket.
-                        # Mid-stream decode/auth failures (bitrot,
-                        # compression damage, GCM auth) arrive AFTER the
-                        # 200 headers went out — abort the connection so
-                        # the client sees a short body, never a clean
-                        # success (the reference likewise aborts the
-                        # response writer).
-                        try:
-                            for chunk in resp.body:
-                                if chunk:
-                                    self.wfile.write(chunk)
-                        except (BrokenPipeError, ConnectionResetError):
-                            raise
-                        except Exception as e:  # noqa: BLE001
-                            from ..logger import Logger
-                            Logger.get().log_once(
-                                f"streaming GET {raw_path} aborted "
-                                f"mid-body: {type(e).__name__}: {e}",
-                                "s3-stream-abort")
-                            self.close_connection = True
-                        finally:
-                            close = getattr(resp.body, "close", None)
-                            if close is not None:
-                                close()
-                            # Streaming: the trace closes only now, so
-                            # it carries the lazy shard-read spans and
-                            # the duration covers the body transfer.
-                            _finish_request()
-                    elif resp.body:
-                        self.wfile.write(resp.body)
-                    if body_is_stream and self.command == "HEAD":
-                        _finish_request()  # stream never consumed
+                    txn = _ThreadedTxn(self, raw_path, query, headers,
+                                       body, body_stream, length)
+                    server._serve_one(txn)
                 except (BrokenPipeError, ConnectionResetError):
                     pass
-                finally:
-                    # Safety nets (both idempotent): a streaming
-                    # response whose client vanished before/while the
-                    # body wrote still gets its metrics/trace
-                    # accounted, and an open span context never leaks
-                    # into the next keep-alive request on this thread.
-                    if finish_fn is not None:
-                        finish_fn()
-                    if root_span is not None:
-                        root_span.finish()
 
             def do_OPTIONS(self):
                 """CORS preflight: unauthenticated by design (ref the
                 preflight path of the CORS middleware)."""
                 raw_path, _, _q = self.path.partition("?")
                 headers = {k.lower(): v for k, v in self.headers.items()}
-                origin = headers.get("origin", "")
-                want = headers.get("access-control-request-method", "")
-                want_headers = [
-                    x.strip().lower() for x in headers.get(
-                        "access-control-request-headers", ""
-                    ).split(",") if x.strip()]
-                bucket = raw_path.lstrip("/").split("/", 1)[0]
-                rule = None
-                if bucket and server.handlers is not None:
-                    rule = server.handlers.cors_match(bucket, origin,
-                                                      want)
-                if rule is not None and want_headers:
-                    allowed = rule["headers"]
-                    if "*" not in allowed and any(
-                            hh not in allowed for hh in want_headers):
-                        rule = None  # requested header not allowed
-                if rule is None:
-                    self.send_response(403)
-                    self.send_header("Content-Length", "0")
-                    self.end_headers()
-                    return
-                self.send_response(200)
-                self.send_header("Access-Control-Allow-Origin", origin)
-                self.send_header("Access-Control-Allow-Methods",
-                                 ", ".join(rule["methods"]))
-                if rule["headers"]:
-                    self.send_header("Access-Control-Allow-Headers",
-                                     ", ".join(rule["headers"]))
-                if rule["max_age"]:
-                    self.send_header("Access-Control-Max-Age",
-                                     rule["max_age"])
-                self.send_header("Content-Length", "0")
+                status, hdrs = server.preflight(raw_path, headers)
+                self.send_response(status)
+                for k, v in hdrs:
+                    self.send_header(k, v)
                 self.end_headers()
 
             do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _handle
@@ -3930,21 +3997,6 @@ class S3Server:
 
         Handler.timeout = 120  # idle keep-alive reaper
         self._httpd = _Server((host, port), Handler)
-        # Timeline sampler: one process-wide daemon deltaing the
-        # registry per sample period (refcounted — the last server to
-        # stop stops it; its tick also drives kernprof's rate-limited
-        # backend recovery probes).
-        from ..obs.timeline import TIMELINE
-        TIMELINE.start()
-        self._timeline_started = True
-        # Incident bundles capture server-scoped context (effective
-        # config, MRF census) through providers — the recorder itself
-        # stays server-agnostic.
-        from ..obs.incidents import INCIDENTS
-        INCIDENTS.providers["config"] = self._incident_config
-        INCIDENTS.providers["mrf"] = self._mrf_stats
-        if cert_manager is not None:
-            cert_manager.start()
         # mtpu-lint: disable=R1 -- the accept loop itself; request context is OPENED per request below it
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
@@ -3976,6 +4028,19 @@ class S3Server:
                     del INCIDENTS.providers[key]
         if getattr(self, "cert_manager", None) is not None:
             self.cert_manager.stop()
+        if getattr(self, "_front_door", None) is not None:
+            # Graceful drain: stop accepting, let in-flight requests
+            # finish within the deadline, then abort stragglers —
+            # the SIGTERM semantics the threaded front end only
+            # approximated with abandoned daemon threads.
+            import os as _os
+            try:
+                drain = float(_os.environ.get(
+                    "MINIO_SHUTDOWN_DRAIN", "10") or 10)
+            except ValueError:
+                drain = 10.0
+            self._front_door.stop(drain_s=drain)
+            self._front_door = None
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -3992,3 +4057,110 @@ class S3Server:
             self.handlers.replication.close()
         if self.audit is not None:
             self.audit.close()
+
+
+class _BoundAddress:
+    """Duck-typed stand-in for the ThreadingHTTPServer attribute
+    surface the rest of the stack reads (`server_address`), when the
+    async front door owns the socket."""
+
+    def __init__(self, host: str, port: int):
+        self.server_address = (host, port)
+
+    def shutdown(self) -> None:
+        pass
+
+    def server_close(self) -> None:
+        pass
+
+
+class _ThreadedTxn:
+    """Transport adapter for the legacy thread-per-connection front
+    end: one request on a ThreadingHTTPServer handler thread, driven
+    through the same `S3Server._serve_one` core as the async front
+    door (`s3/asyncserver._AsyncTxn`)."""
+
+    def __init__(self, handler, raw_path: str, query: str,
+                 headers: dict, body: bytes, body_stream, length: int):
+        self.h = handler
+        self.command = handler.command
+        self.raw_path = raw_path
+        self.query = query
+        self.headers = headers
+        self.body = body
+        self.body_stream = body_stream  # raw LimitReader (or None)
+        self.content_length = length
+        self.rx_length = length
+        self.client_ip = handler.client_address[0]
+        self.close_after = False
+        self.detached = False
+
+    # -- body hygiene ---------------------------------------------------
+
+    def prepare_body_cleanup(self) -> bool:
+        """Keep-alive framing after an early response (shed, burnt
+        deadline, auth failure) left body bytes unread: drain the
+        remainder inline — per Content-Length, so the next pipelined
+        request can never desync. The handler THREAD pays for the
+        whole drain here, however large (this transport has no way to
+        linger a half-closed socket); the async front door instead
+        discards small tails loop-side and closes large ones with a
+        lingering FIN."""
+        bs = self.body_stream
+        if bs is None:
+            return False
+        if bs.remaining() <= 0:
+            return False
+        try:
+            while bs.read(64 * 1024):
+                pass
+        except (OSError, ValueError):
+            self.set_close()
+            return True
+        return False
+
+    def set_close(self) -> None:
+        self.h.close_connection = True
+        self.close_after = True
+
+    # -- response plumbing ----------------------------------------------
+
+    def send_head(self, status: int, headers: list) -> None:
+        self.h.send_response(status)
+        for k, v in headers:
+            self.h.send_header(k, v)
+        self.h.end_headers()
+
+    def write(self, data) -> None:
+        if data:
+            self.h.wfile.write(data)
+
+    def stream_response(self, resp, raw_path: str, finish_fn,
+                        root_span) -> bool:
+        """Drive the iterator body inline on this handler thread (the
+        threaded model: a slow reader parks the thread). Returns False
+        — never detaches; finish_fn runs here and again (idempotent)
+        in the core's finally."""
+        h = self.h
+        try:
+            for chunk in resp.body:
+                if chunk:
+                    h.wfile.write(chunk)
+        except (BrokenPipeError, ConnectionResetError):
+            raise
+        except Exception as e:  # noqa: BLE001
+            from ..logger import Logger
+            Logger.get().log_once(
+                f"streaming GET {raw_path} aborted "
+                f"mid-body: {type(e).__name__}: {e}",
+                "s3-stream-abort")
+            h.close_connection = True
+        finally:
+            close = getattr(resp.body, "close", None)
+            if close is not None:
+                close()
+            # Streaming: the trace closes only now, so it carries the
+            # lazy shard-read spans and the duration covers the body
+            # transfer.
+            finish_fn()
+        return False

@@ -87,6 +87,13 @@ class LimitReader(Reader):
             self._left -= len(chunk)
             return chunk
 
+    def remaining(self) -> int:
+        """Bytes of the capped window not yet consumed — the front
+        door's keep-alive hygiene reads this to decide drain vs close
+        for an abandoned body."""
+        with self._mu:
+            return self._left
+
 
 class PushbackReader(Reader):
     """Prepends already-consumed bytes back onto an inner reader (the

@@ -23,6 +23,7 @@ import pytest
 
 from tools import mtpu_lint
 from tools.mtpu_lint.core import ModuleCtx, run
+from tools.mtpu_lint.rules.asyncblocking import AsyncBlockingRule
 from tools.mtpu_lint.rules.commits import CommitReplaceRule
 from tools.mtpu_lint.rules.concurrency import ThreadCtxRule
 from tools.mtpu_lint.rules.errormap import ErrorMapRule
@@ -475,6 +476,85 @@ def test_r7_scoped_to_storage_package():
     rule = CommitReplaceRule()
     assert not rule.applies(_ctx(src, "minio_tpu/erasure/sample.py"))
     assert not rule.applies(_ctx(src, "tools/sample.py"))
+
+
+# ---------------------------------------------------------------------------
+# R8 — no blocking calls in async def bodies under minio_tpu/s3/
+
+
+def test_r8_flags_blocking_calls_in_async_def():
+    src = (
+        "import time, os\n"
+        "async def handle(sock, lock):\n"
+        "    time.sleep(1)\n"
+        "    lock.acquire()\n"
+        "    sock.recv(1024)\n"
+        "    sock.sendall(b'x')\n"
+        "    open('/tmp/f')\n"
+        "    os.fsync(3)\n")
+    found = _check(AsyncBlockingRule(), src,
+                   "minio_tpu/s3/sample.py")
+    assert len(found) == 6, found
+    assert all("event loop" in f.message for f in found)
+
+
+def test_r8_awaited_calls_and_sync_defs_exempt():
+    src = (
+        "import asyncio\n"
+        "async def pump(loop, pool, fut):\n"
+        "    await asyncio.sleep(0.1)\n"
+        "    await asyncio.wait_for(fut, 5)\n"
+        "    chunk = await loop.run_in_executor(pool, produce)\n"
+        "    transport.write(chunk)\n"
+        "def produce():\n"
+        "    import time\n"
+        "    time.sleep(1)\n"       # sync def: runs off-loop
+        "    lock.acquire()\n")
+    assert _check(AsyncBlockingRule(), src,
+                  "minio_tpu/s3/sample.py") == []
+
+
+def test_r8_nested_sync_def_inside_async_exempt():
+    src = (
+        "async def outer(pool):\n"
+        "    def worker():\n"
+        "        lock.acquire()\n"   # runs on the pool, not the loop
+        "        return 1\n"
+        "    return await pool.run(worker)\n")
+    assert _check(AsyncBlockingRule(), src,
+                  "minio_tpu/s3/sample.py") == []
+
+
+def test_r8_nested_async_def_checked():
+    src = (
+        "def factory():\n"
+        "    async def inner(lock):\n"
+        "        lock.acquire()\n"
+        "    return inner\n")
+    found = _check(AsyncBlockingRule(), src,
+                   "minio_tpu/s3/sample.py")
+    assert len(found) == 1 and "lock acquire" in found[0].message
+
+
+def test_r8_scoped_to_s3_package_with_waiver_escape():
+    src = (
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(1)\n")
+    rule = AsyncBlockingRule()
+    assert not rule.applies(_ctx(src, "minio_tpu/erasure/sample.py"))
+    assert not rule.applies(_ctx(src, "tools/sample.py"))
+    waived = (
+        "import time\n"
+        "async def f():\n"
+        "    # mtpu-lint: disable=R8 -- startup-only coroutine, loop not yet serving\n"
+        "    time.sleep(1)\n")
+    ctx = _ctx(waived, "minio_tpu/s3/sample.py")
+    raw = AsyncBlockingRule().check(ctx)
+    assert len(raw) == 1  # fires pre-suppression…
+    waived_lines = {s.line for s in ctx.suppressions
+                    if "R8" in s.rules}
+    assert all(f.line in waived_lines for f in raw)  # …and is waived
 
 
 # ---------------------------------------------------------------------------

@@ -849,6 +849,15 @@ def test_e2e_fault_plan_fires_drive_alert_with_incident(tmp_path):
                  and "firing" in e.message]
         assert lines and lines[-1].fields["alert_id"] == \
             mine[0]["alertId"]
+        # The gauge is written by the sampler-tick thread moments
+        # after the state flip — under full-suite CPU contention the
+        # assertions above can outrun it, so poll like the census
+        # check below does.
+        deadline = time.time() + 5
+        while time.time() < deadline and METRICS2.get(
+                "minio_tpu_v2_alerts_firing",
+                {"rule": "drive_degraded"}) != 1:
+            time.sleep(0.05)
         assert METRICS2.get("minio_tpu_v2_alerts_firing",
                             {"rule": "drive_degraded"}) == 1
 

@@ -22,6 +22,7 @@ import json
 import random
 import threading
 import time
+import urllib.parse
 
 
 def _percentile(sorted_vals: list[float], q: float) -> float:
@@ -237,6 +238,287 @@ def run_load(host: str, port: int, access_key: str, secret_key: str,
     }
 
 
+class _LatStats:
+    """Percentile accumulator for one metric of one request class."""
+
+    def __init__(self):
+        self.vals: list[float] = []
+
+    def add(self, ms: float) -> None:
+        self.vals.append(ms)
+
+    def report(self) -> dict:
+        vals = sorted(self.vals)
+        return {
+            "count": len(vals),
+            "p50": round(_percentile(vals, 50), 3),
+            "p90": round(_percentile(vals, 90), 3),
+            "p99": round(_percentile(vals, 99), 3),
+            "max": round(vals[-1], 3) if vals else 0.0,
+        }
+
+
+def run_async_load(host: str, port: int, access_key: str,
+                   secret_key: str, bucket: str, *,
+                   connections: int = 100, duration: float = 5.0,
+                   qps: float = 0.0, put_fraction: float = 0.0,
+                   object_bytes: int = 64 * 1024,
+                   key_prefix: str = "fdload", key_space: int = 32,
+                   seed: int = 0, preload: bool = True,
+                   connect_batch: int = 512) -> dict:
+    """High-concurrency driver for the async front door: one asyncio
+    event loop opens and HOLDS ``connections`` keep-alive sockets and
+    runs a closed-loop (or ``qps``-paced) GET/PUT mix over them,
+    reporting connect / TTFB / total-latency percentiles per class.
+
+    The threaded ``run_load`` tops out at a few hundred sockets (one
+    OS thread each) — far below the server it is meant to saturate;
+    this driver holds 10k+ with coroutines.  ``qps`` spreads an
+    AGGREGATE request rate across all connections (the realistic
+    mostly-idle keep-alive regime); ``qps=0`` is fully closed-loop.
+    Each request is individually SigV4-signed like every other client
+    in this repo."""
+    import asyncio
+
+    from minio_tpu.s3 import sigv4
+    from minio_tpu.s3.asyncserver import raise_nofile_limit
+
+    raise_nofile_limit(connections + 256)
+    body = (bytes(random.Random(seed).randbytes(object_bytes))
+            if object_bytes else b"")
+    if preload:
+        from minio_tpu.s3.client import S3Client
+        pre = S3Client(host, port, access_key, secret_key)
+        for r in range(key_space):
+            resp = pre.put_object(bucket, f"{key_prefix}/p{r}", body)
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"preload PUT p{r} failed: {resp.status}")
+
+    stats = {
+        "connect": _LatStats(),
+        "get": {"ttfb": _LatStats(), "total": _LatStats()},
+        "put": {"ttfb": _LatStats(), "total": _LatStats()},
+    }
+    counters = {"requests": 0, "ok": 0, "shed_503": 0, "errors": 0,
+                "reconnects": 0, "connect_failures": 0}
+    status_counts: dict[int, int] = {}
+
+    def _signed(method: str, path: str, payload: bytes) -> bytes:
+        hdrs = {"host": f"{host}:{port}",
+                "content-length": str(len(payload))}
+        hdrs = sigv4.sign_request(method, path, "", hdrs, payload,
+                                  access_key, secret_key)
+        head = [f"{method} {path} HTTP/1.1\r\n"]
+        head.extend(f"{k}: {v}\r\n" for k, v in hdrs.items())
+        head.append("\r\n")
+        return "".join(head).encode("latin-1")
+
+    async def _read_response(reader) -> tuple[int, bool, float]:
+        """(status, keep_alive, ttfb_monotonic) after draining the
+        body per Content-Length."""
+        head = await reader.readuntil(b"\r\n\r\n")
+        ttfb = time.monotonic()
+        lines = head.decode("latin-1").split("\r\n")
+        status = int(lines[0].split(" ", 2)[1])
+        hdrs = {}
+        for line in lines[1:]:
+            k, sep, v = line.partition(":")
+            if sep:
+                hdrs[k.strip().lower()] = v.strip()
+        if status == 100:
+            return await _read_response(reader)
+        cl = int(hdrs.get("content-length", 0) or 0)
+        if cl:
+            await reader.readexactly(cl)
+        keep = hdrs.get("connection", "").lower() != "close"
+        return status, keep, ttfb
+
+    # Aggregate pacer: monotonic slot allocator (single loop, no lock).
+    pacer_next = [time.monotonic()]
+
+    async def _pace() -> bool:
+        """Reserve the next aggregate-rate slot; False = the window
+        closes before this slot (caller exits WITHOUT sending — the
+        whole idle fleet piles onto the pacer at window-open, and
+        slots past stop_at must not extend the run)."""
+        if qps <= 0:
+            return True
+        slot = max(pacer_next[0], time.monotonic())
+        if slot >= stop_at[0]:
+            return False
+        pacer_next[0] = slot + 1.0 / qps
+        delay = slot - time.monotonic()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        return True
+
+    sem = asyncio.Semaphore(connect_batch)
+
+    async def _connect(record: bool):
+        async with sem:
+            t0 = time.monotonic()
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(host, port), timeout=30)
+            sock = writer.get_extra_info("socket")
+            if sock is not None:
+                try:
+                    import socket as _socket
+                    sock.setsockopt(_socket.IPPROTO_TCP,
+                                    _socket.TCP_NODELAY, 1)
+                except OSError:
+                    pass
+            if record:
+                stats["connect"].add((time.monotonic() - t0) * 1e3)
+            return reader, writer
+
+    stop_at = [0.0]
+    arrived = [0]
+    start_ev: list = []  # [asyncio.Event] once the loop exists
+
+    async def _worker(wid: int) -> None:
+        rng = random.Random(seed * 7919 + wid)
+        try:
+            reader, writer = await _connect(record=True)
+        except Exception:
+            counters["connect_failures"] += 1
+            arrived[0] += 1
+            if arrived[0] >= connections:
+                start_ev[0].set()
+            return
+        # Connect barrier: the whole fleet establishes (and idles on
+        # keep-alive) BEFORE the timed window opens, so request
+        # percentiles measure steady state, not the connect storm.
+        arrived[0] += 1
+        if arrived[0] >= connections:
+            start_ev[0].set()
+        await start_ev[0].wait()
+        if qps > 0:
+            # Paced mode: jitter each connection's entry so 10k idle
+            # workers don't stampede the first pacer slots in one
+            # loop wakeup — the aggregate rate is the pacer's job,
+            # the jitter only de-synchronizes the fleet.
+            await asyncio.sleep(rng.random() * min(duration * 0.4,
+                                                   2.0))
+        try:
+            while time.monotonic() < stop_at[0]:
+                if not await _pace():
+                    break
+                do_put = rng.random() < put_fraction
+                key = f"{key_prefix}/p{rng.randrange(key_space)}"
+                path = f"/{bucket}/{urllib.parse.quote(key)}"
+                cls = "put" if do_put else "get"
+                payload = body if do_put else b""
+                raw = _signed("PUT" if do_put else "GET", path, payload)
+                t0 = time.monotonic()
+                try:
+                    writer.write(raw + payload)
+                    await writer.drain()
+                    # No per-response wait_for: it would create one
+                    # extra task per request — real task churn at 10k
+                    # conns. A hung response is bounded by the run's
+                    # outer timeout instead.
+                    status, keep, ttfb = await _read_response(reader)
+                except (OSError, asyncio.IncompleteReadError,
+                        asyncio.LimitOverrunError,
+                        asyncio.TimeoutError):
+                    counters["errors"] += 1
+                    counters["reconnects"] += 1
+                    try:
+                        writer.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    try:
+                        reader, writer = await _connect(record=False)
+                    except Exception:
+                        return
+                    continue
+                now = time.monotonic()
+                counters["requests"] += 1
+                status_counts[status] = status_counts.get(status, 0) + 1
+                if 200 <= status < 300:
+                    counters["ok"] += 1
+                    stats[cls]["ttfb"].add((ttfb - t0) * 1e3)
+                    stats[cls]["total"].add((now - t0) * 1e3)
+                elif status == 503:
+                    counters["shed_503"] += 1
+                else:
+                    counters["errors"] += 1
+                if not keep:
+                    counters["reconnects"] += 1
+                    writer.close()
+                    try:
+                        reader, writer = await _connect(record=False)
+                    except Exception:
+                        return
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def _run() -> float:
+        start_ev.append(asyncio.Event())
+
+        win_t0 = [0.0]
+
+        async def _open_window() -> None:
+            await start_ev[0].wait()
+            # The fleet is established: freeze it out of GC and stop
+            # collection for the timed window — a gen-2 pass over 10k
+            # connection objects is a multi-ms pause that would read
+            # as server tail latency.
+            import gc
+            gc.collect()
+            gc.freeze()
+            gc.disable()
+            win_t0[0] = time.monotonic()
+            stop_at[0] = win_t0[0] + duration
+            pacer_next[0] = win_t0[0]
+
+        # Generous far-future stop until the barrier opens the real
+        # window (workers check stop_at only after the barrier).
+        stop_at[0] = time.monotonic() + duration + 600
+        opener = asyncio.ensure_future(_open_window())
+        workers = [asyncio.ensure_future(_worker(i))
+                   for i in range(connections)]
+        t0 = time.monotonic()
+        await asyncio.gather(*workers, return_exceptions=True)
+        opener.cancel()
+        import gc
+        gc.enable()
+        end = time.monotonic()
+        return end - (win_t0[0] or t0)
+
+    elapsed = asyncio.run(_run())
+    total = counters["requests"]
+    return {
+        "connections": connections,
+        "established": stats["connect"].report()["count"],
+        "connect_failures": counters["connect_failures"],
+        "reconnects": counters["reconnects"],
+        "requests": total,
+        "ok": counters["ok"],
+        "shed_503": counters["shed_503"],
+        "shed_rate": round(counters["shed_503"] / total, 4)
+        if total else 0.0,
+        "errors_other": counters["errors"],
+        "status_counts": {str(k): v for k, v in
+                          sorted(status_counts.items())},
+        "qps_achieved": round(total / elapsed, 2) if elapsed else 0.0,
+        "connect_ms": stats["connect"].report(),
+        "get": {"ttfb_ms": stats["get"]["ttfb"].report(),
+                "total_ms": stats["get"]["total"].report()},
+        "put": {"ttfb_ms": stats["put"]["ttfb"].report(),
+                "total_ms": stats["put"]["total"].report()},
+        "elapsed_s": round(elapsed, 3),
+        "config": {"connections": connections, "duration_s": duration,
+                   "qps_target": qps, "put_fraction": put_fraction,
+                   "object_bytes": object_bytes,
+                   "key_space": key_space},
+    }
+
+
 def _xml_code(body: bytes) -> str:
     """<Code>X</Code> out of an S3 error body, tag-sliced so the parser
     never chokes on a truncated response."""
@@ -272,19 +554,35 @@ def main() -> int:
                    help="PUT the whole key space before the timed "
                         "window (for pure-GET runs)")
     p.add_argument("--make-bucket", action="store_true")
+    p.add_argument("--connections", type=int, default=0,
+                   help="high-concurrency mode: hold N keep-alive "
+                        "sockets on one asyncio loop (closed-loop, or "
+                        "--qps paced across the fleet); reports "
+                        "connect/TTFB/total percentiles per class")
     args = p.parse_args()
     if args.make_bucket:
         from minio_tpu.s3.client import S3Client
         S3Client(args.host, args.port, args.access_key,
                  args.secret_key).make_bucket(args.bucket)
-    report = run_load(args.host, args.port, args.access_key,
-                      args.secret_key, args.bucket,
-                      concurrency=args.concurrency,
-                      duration=args.duration, qps=args.qps,
-                      put_fraction=args.put_fraction,
-                      object_bytes=args.size,
-                      key_space=args.key_space, zipf_s=args.zipf,
-                      preload=args.preload)
+    if args.connections > 0:
+        report = run_async_load(args.host, args.port, args.access_key,
+                                args.secret_key, args.bucket,
+                                connections=args.connections,
+                                duration=args.duration, qps=args.qps,
+                                put_fraction=args.put_fraction,
+                                object_bytes=args.size,
+                                key_space=args.key_space,
+                                preload=args.preload or
+                                args.put_fraction < 1.0)
+    else:
+        report = run_load(args.host, args.port, args.access_key,
+                          args.secret_key, args.bucket,
+                          concurrency=args.concurrency,
+                          duration=args.duration, qps=args.qps,
+                          put_fraction=args.put_fraction,
+                          object_bytes=args.size,
+                          key_space=args.key_space, zipf_s=args.zipf,
+                          preload=args.preload)
     print(json.dumps(report, indent=2))
     return 0
 

@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+from .asyncblocking import AsyncBlockingRule
 from .commits import CommitReplaceRule
 from .concurrency import ThreadCtxRule
 from .errormap import ErrorMapRule
@@ -24,6 +25,7 @@ def all_rules():
         ErrorMapRule(),
         BoundedRetryRule(),
         CommitReplaceRule(),
+        AsyncBlockingRule(),
         NativeAssertRule(),
         MetricNameRule(),
         QosMetricCallRule(),

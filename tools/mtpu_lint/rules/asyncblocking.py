@@ -1,0 +1,109 @@
+"""R8 — no blocking calls inside ``async def`` bodies under
+``minio_tpu/s3/``.
+
+The async front door (``s3/asyncserver.py``) runs accept/parse/
+keep-alive for 10k+ sockets on a handful of event-loop threads; ONE
+blocking call in a coroutine stalls every connection on that loop.
+The architecture keeps all blocking work on the worker pool (request
+execution) or behind ``run_in_executor`` (streaming-response chunk
+pulls) — this rule makes a regression of that boundary a lint failure.
+
+Flagged inside ``async def`` bodies (nested sync ``def``s are skipped —
+they run on whatever thread calls them, which the loop must not):
+
+- ``time.sleep`` (use ``asyncio.sleep``)
+- blocking synchronization: ``.acquire()``, ``.wait()`` (threading
+  locks / events / conditions)
+- raw socket I/O: ``.recv()`` / ``.recv_into()`` / ``.send()`` /
+  ``.sendall()`` / ``.sendfile()`` / ``.accept()`` / ``.connect()``
+  (use the loop's ``sock_*`` coroutines or transports)
+- file I/O helpers: ``open()`` and the blocking ``os.*`` file calls
+
+A DIRECTLY AWAITED call is exempt: ``await asyncio.wait_for(...)`` and
+friends are coroutines, not blockers — the await is the proof.  Sites
+with a genuine reason (none are expected) carry the usual justified
+``# mtpu-lint: disable=R8 -- why`` waiver.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, dotted_name, terminal_name
+
+_BLOCKING_ATTRS = {
+    "acquire": "blocking lock acquire",
+    "wait": "blocking wait",
+    "recv": "blocking socket recv",
+    "recv_into": "blocking socket recv",
+    "send": "blocking socket send",
+    "sendall": "blocking socket send",
+    "sendfile": "blocking socket send",
+    "accept": "blocking socket accept",
+    "connect": "blocking socket connect",
+}
+
+_BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep (use asyncio.sleep)",
+    "os.read": "blocking file I/O",
+    "os.write": "blocking file I/O",
+    "os.fsync": "blocking file I/O",
+    "os.replace": "blocking file I/O",
+    "os.rename": "blocking file I/O",
+    "os.remove": "blocking file I/O",
+    "os.stat": "blocking file I/O",
+    "os.listdir": "blocking file I/O",
+    "os.makedirs": "blocking file I/O",
+}
+
+
+class AsyncBlockingRule(Rule):
+    id = "R8"
+    title = ("no blocking calls (socket I/O, time.sleep, lock acquire, "
+             "file I/O) inside async def bodies under minio_tpu/s3/")
+
+    def applies(self, ctx) -> bool:
+        return ctx.relpath.startswith("minio_tpu/s3/")
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._walk_async_body(node)
+        # Keep descending: nested async defs get their own walk, and
+        # nested SYNC defs may contain further async defs.
+        self.generic_visit(node)
+
+    def _walk_async_body(self, func: ast.AsyncFunctionDef) -> None:
+        stack: list[ast.AST] = list(ast.iter_child_nodes(func))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                continue  # runs elsewhere / walked separately
+            if isinstance(node, ast.Await):
+                # A directly awaited call is a coroutine by
+                # definition; only descend into its ARGUMENTS.
+                inner = node.value
+                if isinstance(inner, ast.Call):
+                    stack.extend(inner.args)
+                    stack.extend(kw.value for kw in inner.keywords)
+                    continue
+            if isinstance(node, ast.Call):
+                why = self._blocking_reason(node)
+                if why is not None:
+                    self.flag(node, (
+                        f"{why} inside `async def {func.name}` stalls "
+                        "every connection on this event loop — move it "
+                        "to the worker pool (run_in_executor) or use "
+                        "the async equivalent"))
+            stack.extend(ast.iter_child_nodes(node))
+
+    @staticmethod
+    def _blocking_reason(node: ast.Call) -> str | None:
+        func = node.func
+        if isinstance(func, ast.Name) and func.id == "open":
+            return "blocking file open"
+        dotted = dotted_name(func)
+        if dotted in _BLOCKING_DOTTED:
+            return _BLOCKING_DOTTED[dotted]
+        if isinstance(func, ast.Attribute):
+            return _BLOCKING_ATTRS.get(terminal_name(func))
+        return None

@@ -115,6 +115,12 @@ def render(doc: dict, width: int = 60) -> str:
     tx = last.get("tx", 0) / dt(last) / (1 << 20)
     lines.append(f"rx {rx:.2f} MiB/s   tx {tx:.2f} MiB/s   "
                  f"admission queue {_num(last.get('queueDepth', 0))}")
+    # Connection plane (async front door): open keep-alive sockets,
+    # accept backlog, framing rejections this window.
+    lines.append(
+        f"conns: open {_num(last.get('conns', 0))}  "
+        f"accept-queue {_num(last.get('acceptQueue', 0))}  "
+        f"parse-err/s {_num(last.get('parseErrors', 0) / dt(last))}")
     # Hot-object cache row: hit ratio over the last window + resident
     # bytes (the serving tier's live effectiveness at a glance).
     ch = last.get("cacheHits", 0)
