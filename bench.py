@@ -363,6 +363,121 @@ def bench_encode_verify(np, device: bool) -> dict:
             "device": device, "hh_tpu_dispatches": hh_tpu}
 
 
+# --- config: codec autotuner — paired tuned-vs-untuned dispatch --------------
+
+
+def bench_codec_autotune(np) -> dict:
+    """Measured-plan dispatch vs the legacy static device-first policy,
+    PAIRED per batch-size bucket (alternating order, like put_p50's
+    overhead pairs — this VM drifts +/-20% on second timescales, so
+    only the within-pair delta is trustworthy).  Stamps the probe
+    ladder's full crossover table and the converged plan; the
+    acceptance bar is tuned >= untuned within noise on every bucket —
+    on a no-device box both policies should converge on host-native
+    (BENCH_r04/r05's lesson), so the deltas measure planner overhead,
+    not lane wins."""
+    from minio_tpu.erasure.codec import Erasure
+    from minio_tpu.ops.autotune import AUTOTUNE
+
+    AUTOTUNE.reset()
+    ladder = AUTOTUNE.probe_ladder()
+
+    k, m = 8, 4
+    # (bucket, B, data bytes) — S = bytes / (B*k); one case per plan
+    # bucket the serving path actually exercises.
+    cases = (("<64K", 1, 32 * 1024),
+             ("64K-1M", 8, 512 * 1024),
+             ("1-4M", 8, 2 * 1024 * 1024),
+             ("4-16M", 8, 8 * 1024 * 1024))
+    codec = Erasure(k, m, block_size=1024 * 1024)
+    rng = np.random.default_rng(7)
+    buckets: dict[str, dict] = {}
+    worst_speedup = None
+    best_tuned = 0.0
+    for bucket, B, nbytes in cases:
+        S = nbytes // (B * k)
+        blocks = rng.integers(0, 256, (B, k, S)).astype(np.uint8)
+
+        def encode_once(blocks=blocks) -> float:
+            t0 = time.perf_counter()
+            codec.encode_blocks_batch(blocks)
+            return time.perf_counter() - t0
+
+        encode_once()  # warm (native lib, jit shapes, caches)
+        tuned: list[float] = []
+        untuned: list[float] = []
+        try:
+            for i in range(6):
+                order = (True, False) if i % 2 == 0 else (False, True)
+                for on in order:
+                    AUTOTUNE.enabled = on
+                    (tuned if on else untuned).append(encode_once())
+        finally:
+            AUTOTUNE.enabled = True
+        t_t = statistics.median(tuned)
+        t_u = statistics.median(untuned)
+        speedup = round(t_u / max(t_t, 1e-9), 3)
+        lane = AUTOTUNE.decide("rs_encode", nbytes)
+        gibs = nbytes / t_t / (1 << 30)
+        best_tuned = max(best_tuned, gibs)
+        buckets[bucket] = {
+            "chosen_lane": lane,
+            "tuned_GiBs": round(gibs, 3),
+            "untuned_GiBs": round(nbytes / t_u / (1 << 30), 3),
+            "tuned_over_untuned": speedup,
+        }
+        if worst_speedup is None or speedup < worst_speedup:
+            worst_speedup = speedup
+    return {"metric": "codec_autotune_encode",
+            "value": round(best_tuned, 3), "unit": "GiB/s",
+            # Paired acceptance signal: min tuned/untuned across
+            # buckets (>= ~1.0 within noise = the planner never made
+            # dispatch slower).
+            "worst_tuned_over_untuned": worst_speedup,
+            "buckets": buckets,
+            "crossover_GiBs": ladder,
+            "plan": AUTOTUNE.plan_compact()}
+
+
+def bench_north_star_scaling(np) -> dict:
+    """n_devices-aware north star: sweep serving meshes of 1..N
+    devices (batching.set_mesh_devices) and report the encode scaling
+    curve.  Empty on a single-device box — the sweep only means
+    something when jax exposes a mesh (the MULTICHIP harness reports
+    8), and this process pins jax to CPU so a relay-less run is 1."""
+    import jax
+
+    from minio_tpu.ops import batching, rs_tpu
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        return {}
+    k, m = 8, 4
+    S = (1 << 20) // k
+    steps = sorted({n for n in (1, 2, 4, 8, n_dev) if n <= n_dev})
+    curve: dict[str, float] = {}
+    rng = np.random.default_rng(0)
+    try:
+        for n in steps:
+            batching.set_mesh_devices(n)
+            batch = 8 * max(1, n)  # B divides every mesh in the sweep
+            data = rng.integers(0, 256, (batch, k, S)).astype(np.uint8)
+            rs_tpu.encode_batch(data, k, m)  # warm/compile
+            t = min(
+                _timed_call(lambda: rs_tpu.encode_batch(data, k, m))
+                for _ in range(3))
+            curve[str(n)] = round(
+                batch * k * S / t / (1 << 30), 3)
+    finally:
+        batching.set_mesh_devices(None)
+    return {"devices": n_dev, "scaling_GiBs": curve}
+
+
+def _timed_call(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
 # --- config 3: 12+4 multipart upload through the engine ----------------------
 
 
@@ -1465,6 +1580,8 @@ def main() -> None:
                        "get_2lost": "get", "heal": "heal"}
     configs: list[dict] = []
     for name, fn in (("put_p50", lambda: bench_put_p50(np, workdir)),
+                     ("codec_autotune",
+                      lambda: bench_codec_autotune(np)),
                      ("encode_verify",
                       lambda: bench_encode_verify(np, False)),
                      ("multipart", lambda: bench_multipart(np, workdir)),
@@ -1520,6 +1637,10 @@ def main() -> None:
             # masquerade as a device number again — the exact r04/r05
             # ambiguity the ROADMAP bench caveat flags.
             res["backend_mix"] = factor_box.get("mix", {})
+            # The codec dispatch plan in force when this config ran —
+            # the lane story behind the backend_mix fractions.
+            from minio_tpu.ops.autotune import AUTOTUNE as _AT
+            res.setdefault("codec_plan", _AT.plan_compact())
             suspect, faulty = DRIVEMON.counts()
             res["drive_suspect"] = suspect
             res["drive_faulty"] = faulty
@@ -1586,6 +1707,14 @@ def main() -> None:
     out["kernel_backends"] = {
         b: info["state"]
         for b, info in KERNPROF.snapshot()["backends"].items()}
+    # Whole-run codec-plan stamp (next to backend_mix): which lane the
+    # measured planner routed each (kernel, bucket) to by run end.
+    from minio_tpu.ops.autotune import AUTOTUNE
+    out["codec_plan"] = AUTOTUNE.plan_compact()
+    # n_devices-aware scaling curve ({} on a single-device box).
+    scaling = bench_north_star_scaling(np)
+    if scaling:
+        out["north_star_scaling"] = scaling
     if errors:
         out["errors"] = errors
     print(json.dumps(out))

@@ -112,6 +112,17 @@ DEFAULT_KVS: dict[str, dict[str, str]] = {
         "max_object_bytes": "33554432",
         "revalidate": "1s",
     },
+    # Codec dispatch autotuner (ops/autotune.py): autotune=off pins
+    # the legacy static device-first policy; hysteresis is the
+    # challenger-over-incumbent throughput factor a plan flip needs
+    # (>= 1.0 — 1.0 flips on any faster sample); probe_on_boot=off
+    # skips the boot probe ladder (the plan then builds from live
+    # dispatch samples only).
+    "codec": {
+        "autotune": "on",
+        "hysteresis": "1.25",
+        "probe_on_boot": "on",
+    },
     # Structured logging (logger/logger.py): json=on makes every
     # console line a JSON object with structured fields (alert lines
     # carry alert_id/rule join keys). MINIO_LOG_JSON=1 is the legacy

@@ -8,8 +8,11 @@ the Split padding of its codec dependency): objects are striped into
 bytes (zero-padded) plus m parity shards.
 
 Backend selection (SURVEY §7 hard part c): the TPU sits behind an ~80ms
-relay RPC, so small single blocks encode on the host (numpy/C++) while
-large objects and heal sweeps batch many blocks per device dispatch.
+relay RPC, so small batches must not pay a device round-trip.  The
+crossover is MEASURED, not hardwired: ``ops/autotune.py`` probes every
+dispatch lane at boot and refines per-(kernel, batch-size-bucket)
+throughput from live dispatches; this module only consults the plan
+(pinned ``backend="tpu"|"cpu"`` bypasses it).
 """
 
 from __future__ import annotations
@@ -19,14 +22,17 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..ops import batching, rs_cpu, rs_tpu
+from ..ops.autotune import (AUTOTUNE, DEFAULT_DEVICE_MIN_BYTES,
+                            RS_DECODE, RS_ENCODE)
 from ..utils import ceil_frac
 
 # Default stripe block: 10 MiB (ref cmd/object-api-common.go:32).
 BLOCK_SIZE = 10 * 1024 * 1024
 
-# Blocks at least this large go to the TPU when a device is available;
-# smaller ones encode on host to avoid paying device-dispatch latency.
-TPU_MIN_BYTES = 4 * 1024 * 1024
+# Back-compat alias: the static pre-measurement crossover now lives in
+# ops/autotune.py (the one sanctioned hardwired threshold, R9); no
+# dispatch decision compares against it here anymore.
+TPU_MIN_BYTES = DEFAULT_DEVICE_MIN_BYTES
 
 
 @dataclass
@@ -35,7 +41,10 @@ class Erasure:
     parity_blocks: int
     block_size: int = BLOCK_SIZE
     backend: str = "auto"  # "auto" | "cpu" | "tpu"
-    _tpu_ok: bool | None = field(default=None, repr=False)
+    # Home device of the owning erasure set (parallel/mesh.py
+    # DeviceAffinity, assigned by ErasureObjects): concurrent sets'
+    # dispatches spread across the mesh instead of queueing on chip 0.
+    affinity: int | None = field(default=None, repr=False)
 
     def __post_init__(self):
         if self.data_blocks <= 0 or self.parity_blocks <= 0:
@@ -77,27 +86,35 @@ class Erasure:
 
     # --- encode / decode ---
 
-    def _use_tpu(self, nbytes: int) -> bool:
+    def _use_tpu(self, nbytes: int, kernel: str = RS_ENCODE) -> bool:
+        """Route this batch through the jitted rs_tpu path?  Pins win
+        ("cpu" never, "tpu" always — the operator asked for errors,
+        not silent rerouting); "auto" asks the measured plan
+        (ops/autotune.py), which never picks a kernprof-DOWN lane."""
         if self.backend == "cpu":
             return False
         if self.backend == "tpu":
             return True
-        if nbytes < TPU_MIN_BYTES:
-            return False
-        if self._tpu_ok is None:
-            try:
-                import jax
-                self._tpu_ok = any(
-                    d.platform != "cpu" for d in jax.devices())
-            except Exception:
-                self._tpu_ok = False
-        return bool(self._tpu_ok)
+        return AUTOTUNE.use_jit_lane(kernel, nbytes)
+
+    def _use_tpu_decode(self, nbytes: int) -> bool:
+        return self._use_tpu(nbytes, RS_DECODE)
+
+    # Note: the host branches below consult the planner a second time
+    # (AUTOTUNE.host_lane) after _use_tpu said "not jit".  Deliberate:
+    # _use_tpu is the test-override seam (monkeypatched to force the
+    # jit path), so the decision can't be collapsed into one call
+    # without breaking it; the second consult is a dict lookup per
+    # DISPATCH, and a plan flip between the two calls just falls back
+    # to the native-first default — benign and self-correcting.
 
     def _coalesce_ok(self) -> bool:
-        """Route encodes through the cross-request coalescer? Only when
-        a real device exists (the window buys nothing on host-only) and
-        the backend isn't pinned."""
-        return (self.backend == "auto" and batching.device_present())
+        """Route encodes through the cross-request coalescer? Only
+        when the backend isn't pinned and the plan still sends encode
+        work to a real device — the window buys nothing (and costs its
+        latency) in front of host encodes."""
+        return (self.backend == "auto"
+                and AUTOTUNE.coalesce_worthwhile())
 
     def encode_data(self, data: bytes | np.ndarray) -> np.ndarray:
         """Encode one block: returns (k+m, shard_len) uint8
@@ -110,23 +127,36 @@ class Erasure:
         if self.backend == "tpu":
             out = rs_tpu.encode_batch(
                 shards[None, :self.data_blocks, :],
-                self.data_blocks, self.parity_blocks)[0]
+                self.data_blocks, self.parity_blocks,
+                affinity=self.affinity)[0]
             batching.STATS.add(True, shards[:self.data_blocks].nbytes)
             return out
+        data_bytes = shards[:self.data_blocks].nbytes
         if self._coalesce_ok():
             return batching.get_coalescer().encode(
                 shards[None, :self.data_blocks, :],
-                self.data_blocks, self.parity_blocks)[0]
-        from ..obs.kernel_stats import KERNEL, RS_ENCODE, timed
+                self.data_blocks, self.parity_blocks,
+                affinity=self.affinity)[0]
+        if self._use_tpu(data_bytes):
+            # Plan picked the jit lane while the coalescer window is
+            # off (e.g. XLA-CPU measured fastest with no device): one
+            # direct dispatch.
+            out = rs_tpu.encode_batch(
+                shards[None, :self.data_blocks, :],
+                self.data_blocks, self.parity_blocks,
+                affinity=self.affinity)[0]
+            batching.STATS.add(True, data_bytes)
+            return out
+        from ..obs.kernel_stats import KERNEL, timed
         from ..ops.rs_matrix import parity_matrix
         with timed() as t:
             parity, host_backend = batching.host_apply_tagged(
                 parity_matrix(self.data_blocks, self.parity_blocks),
-                shards[:self.data_blocks])
+                shards[:self.data_blocks],
+                AUTOTUNE.host_lane(RS_ENCODE, data_bytes))
             shards[self.data_blocks:] = parity
-        batching.STATS.add(False, shards[:self.data_blocks].nbytes)
-        KERNEL.record(RS_ENCODE, False,
-                      shards[:self.data_blocks].nbytes, t.s, blocks=1,
+        batching.STATS.add(False, data_bytes)
+        KERNEL.record(RS_ENCODE, False, data_bytes, t.s, blocks=1,
                       backend=host_backend)
         return shards
 
@@ -136,14 +166,17 @@ class Erasure:
         (and still coalescable with concurrent requests)."""
         if self._use_tpu(blocks.nbytes):
             out = rs_tpu.encode_batch(blocks, self.data_blocks,
-                                      self.parity_blocks)
+                                      self.parity_blocks,
+                                      affinity=self.affinity)
             batching.STATS.add(True, blocks.nbytes)
             return out
         if self._coalesce_ok():
             return batching.get_coalescer().encode(
-                blocks, self.data_blocks, self.parity_blocks)
-        return batching.host_encode(blocks, self.data_blocks,
-                                    self.parity_blocks)
+                blocks, self.data_blocks, self.parity_blocks,
+                affinity=self.affinity)
+        return batching.host_encode(
+            blocks, self.data_blocks, self.parity_blocks,
+            lane=AUTOTUNE.host_lane(RS_ENCODE, blocks.nbytes))
 
     def encode_blocks_batch_shardmajor(self, blocks: np.ndarray,
                                        ) -> np.ndarray:
@@ -156,7 +189,8 @@ class Erasure:
             encoded = self.encode_blocks_batch(blocks)
             return np.ascontiguousarray(encoded.transpose(1, 0, 2))
         return batching.host_encode_shardmajor(
-            blocks, self.data_blocks, self.parity_blocks)
+            blocks, self.data_blocks, self.parity_blocks,
+            lane=AUTOTUNE.host_lane(RS_ENCODE, blocks.nbytes))
 
     def decode_data_blocks(self, shards: list[np.ndarray | None],
                            ) -> list[np.ndarray]:
@@ -178,8 +212,9 @@ class Erasure:
         per-call ReconstructData, cmd/erasure-decode.go:214)."""
         return batching.reconstruct_blocks(
             blocks, self.data_blocks, self.parity_blocks,
-            want_all=False, use_device=self._use_tpu,
-            device_fallback=self.backend != "tpu")
+            want_all=False, use_device=self._use_tpu_decode,
+            device_fallback=self.backend != "tpu",
+            affinity=self.affinity)
 
     def decode_all_blocks_batch(self, blocks: list,
                                 ) -> list[list[np.ndarray]]:
@@ -187,5 +222,6 @@ class Erasure:
         rebuilt by a single combined matrix per mask group."""
         return batching.reconstruct_blocks(
             blocks, self.data_blocks, self.parity_blocks,
-            want_all=True, use_device=self._use_tpu,
-            device_fallback=self.backend != "tpu")
+            want_all=True, use_device=self._use_tpu_decode,
+            device_fallback=self.backend != "tpu",
+            affinity=self.affinity)

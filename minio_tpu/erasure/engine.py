@@ -240,6 +240,17 @@ class ErasureObjects:
         # bytes; invalidation addresses (bucket, key) and clears every
         # namespace.
         self.cache_ns = uuid.uuid4().hex[:16]
+        # Per-set device affinity (parallel/mesh.py DeviceAffinity):
+        # on a multi-chip mesh each erasure set gets a home device, so
+        # concurrent sets' codec dispatches spread across chips
+        # instead of all queueing on device 0 (None off-mesh; jax
+        # failures must never block engine construction).
+        try:
+            from ..parallel.mesh import MESH_AFFINITY
+            self.device_affinity = MESH_AFFINITY.assign(self.cache_ns)
+        except Exception:
+            self.device_affinity = None
+        self.codec.affinity = self.device_affinity
 
     def shutdown(self) -> None:
         """Stop this engine's background daemons — the MRF heal queue
@@ -253,6 +264,12 @@ class ErasureObjects:
         self.mrf.stop()
         self.new_disk_monitor.stop()
         self.quarantine_prober.stop()
+        if getattr(self, "device_affinity", None) is not None:
+            try:
+                from ..parallel.mesh import MESH_AFFINITY
+                MESH_AFFINITY.release(self.cache_ns)
+            except Exception:
+                pass
 
     def _mark_update(self, bucket: str, object_name: str = "") -> None:
         self.update_tracker.mark(bucket, object_name)
@@ -468,6 +485,9 @@ class ErasureObjects:
         codec = self._codec_cache.get(key)
         if codec is None:
             codec = Erasure(k, m, bs)
+            # Per-object geometries still dispatch from THIS set: they
+            # share its home device.
+            codec.affinity = getattr(self, "device_affinity", None)
             self._codec_cache[key] = codec
         return codec
 

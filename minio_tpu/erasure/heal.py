@@ -253,6 +253,9 @@ class Healer:
         shard_size = fi.erasure.shard_size()
         missing_shards = sorted(shard_of_disk[i] for i in bad)
         codec = Erasure(k, m, fi.erasure.block_size)
+        # Heal reconstructs dispatch from this set too: same home
+        # device as the serving codec (parallel/mesh.py affinity).
+        codec.affinity = getattr(self.engine, "device_affinity", None)
         from ..storage.metadata import ObjectPartInfo
         parts = fi.parts or [ObjectPartInfo(number=1, size=fi.size,
                                             actual_size=fi.size)]

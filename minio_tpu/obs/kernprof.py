@@ -140,6 +140,12 @@ class KernelProfiler:
                      {"kernel": kernel, "backend": backend}, nbytes)
         if transition is not None:
             self._flush_announcements()
+        # Live sample for the codec dispatch planner: the per-dispatch
+        # profile layer is exactly what a probe-and-pick autotuner
+        # reads (ops/autotune.py refines its throughput model from
+        # every real dispatch).
+        from ..ops.autotune import AUTOTUNE
+        AUTOTUNE.observe(kernel, backend, nbytes, wall_s)
         # Worst-dispatch exemplar for the current timeline window.
         from .timeline import TIMELINE
         TIMELINE.note_kernel(kernel, backend, wall_s * 1e3)

@@ -303,6 +303,24 @@ METRICS2.register(
     "Recovery probes of kernel dispatch backends, by backend and "
     "result (pass/fail).")
 METRICS2.register(
+    "minio_tpu_v2_codec_plan_lane", "gauge",
+    "Codec autotuner plan: chosen dispatch lane per (kernel, batch "
+    "size bucket) as an index into kernprof BACKENDS "
+    "(0=device 1=native 2=xla-cpu 3=host).")
+METRICS2.register(
+    "minio_tpu_v2_codec_plan_transitions_total", "counter",
+    "Codec autotuner plan flips, by kernel, bucket and new lane "
+    "(every flip also logs its cause and lands a codec.plan span "
+    "event).")
+METRICS2.register(
+    "minio_tpu_v2_codec_plan_probes_total", "counter",
+    "Codec autotuner probe-ladder dispatches, by lane and result "
+    "(pass/fail).")
+METRICS2.register(
+    "minio_tpu_v2_codec_plan_fanout_total", "counter",
+    "Coalesced encode windows fanned out as parallel per-device "
+    "dispatches, by device count.")
+METRICS2.register(
     "minio_tpu_v2_traces_completed_total", "counter",
     "Completed request traces.")
 METRICS2.register(

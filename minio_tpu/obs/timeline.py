@@ -249,6 +249,7 @@ class Timeline:
                        "quarantined":
                            len(DRIVEMON.quarantined_endpoints())},
             "backendState": KERNPROF.states(),
+            "codecPlan": _codec_plan(),
         }
 
     def tick(self, now: float | None = None) -> dict | None:
@@ -329,6 +330,11 @@ class Timeline:
                 "mrfJournal": raw.get("mrfJournal", 0),
                 "drives": dict(raw["drives"]),
                 "backendState": dict(raw["backendState"]),
+                # Codec dispatch plan census (gauge-like): flat
+                # {"kernel/bucket": lane index} from ops/autotune.py,
+                # so a plan flip is visible in the same ring as the
+                # backend-state flip that usually caused it.
+                "codecPlan": dict(raw.get("codecPlan") or {}),
                 # Alert census at sample time (the watchdog evaluates
                 # AFTER each tick, so this reflects the previous
                 # evaluation — one period of honest lag).
@@ -387,6 +393,11 @@ def slice_samples(items: list[dict], n: int | None = None,
     return items
 
 
+def _codec_plan() -> dict[str, int]:
+    from ..ops.autotune import AUTOTUNE
+    return AUTOTUNE.plan_indices()
+
+
 def _bucket(t: float, period_s: float) -> float:
     return round(int(t / period_s) * period_s, 3)
 
@@ -430,6 +441,8 @@ def _collapse_node(snap: dict, period_s: float) -> list[dict]:
             "drives": dict(last.get("drives") or {}),
             # Census, not a counter: the node's LATEST alert state.
             "alerts": dict(last.get("alerts") or {}),
+            # Census like alerts: the bucket's latest codec plan.
+            "codecPlan": dict(last.get("codecPlan") or {}),
             "backendState": {},
         }
         for s in group:
@@ -493,6 +506,7 @@ def merge_timelines(snapshots: list[dict],
                                "quarantined": 0},
                     "alerts": {"firing": 0, "pending": 0,
                                "worst": ""},
+                    "codecPlan": {},
                     "backendState": {},
                 }
             cur["nodes"] += int(s.get("nodes", 1))
@@ -520,6 +534,12 @@ def merge_timelines(snapshots: list[dict],
             for k, v in (s.get("backendState") or {}).items():
                 cur["backendState"][k] = max(
                     cur["backendState"].get(k, 0), v)
+            # Per-(kernel/bucket) WORST lane across nodes (highest
+            # index = furthest from the device), same rule as backend
+            # states: a cluster where any node fell back should say so.
+            for k, v in (s.get("codecPlan") or {}).items():
+                cur["codecPlan"][k] = max(cur["codecPlan"].get(k, 0),
+                                          v)
             w = s.get("worstRequest")
             if w and w.get("durationMs", 0) > cur.get(
                     "worstRequest", {}).get("durationMs", -1):

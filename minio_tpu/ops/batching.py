@@ -127,6 +127,9 @@ class ReconstructError(ValueError):
 _serving_mesh = None
 _serving_mesh_built = False
 _mesh_lock = threading.Lock()
+# Bench/test knob: cap the serving mesh at the first n devices (the
+# n_devices-aware north-star sweep measures the scaling curve 1..N).
+_mesh_n_override: int | None = None
 
 
 def serving_mesh():
@@ -142,9 +145,12 @@ def serving_mesh():
                 mesh = None
                 try:
                     import jax
-                    if len(jax.devices()) > 1:
+                    n = len(jax.devices())
+                    want = n if _mesh_n_override is None \
+                        else min(_mesh_n_override, n)
+                    if n > 1 and want > 1:
                         from ..parallel.mesh import make_mesh
-                        mesh = make_mesh()
+                        mesh = make_mesh(want)
                 except Exception:
                     mesh = None
                 _serving_mesh = mesh
@@ -160,17 +166,50 @@ def reset_serving_mesh() -> None:
         _serving_mesh_built = False
 
 
-def device_put_batch(x):
-    """np (B, R, S) -> device array, sharded across the serving mesh
-    when one exists (parallel/mesh.batch_sharding semantics)."""
+def set_mesh_devices(n: int | None) -> None:
+    """Cap the serving mesh at the first n devices (None = all) and
+    rebuild — the n_devices-aware north-star sweep (bench.py) measures
+    the 1..N scaling curve through this."""
+    global _mesh_n_override
+    _mesh_n_override = n
+    reset_serving_mesh()
+
+
+def device_put_batch(x, affinity: int | None = None):
+    """np (B, R, S) -> device array: sharded across the serving mesh
+    when an axis divides it, pinned WHOLE to the owning erasure set's
+    home device otherwise (parallel/mesh.batch_placement — concurrent
+    sets' small dispatches spread across chips instead of all queueing
+    on device 0).  Every placement lands in the MESH_AFFINITY census
+    so the spread is provable."""
     import jax
     import jax.numpy as jnp
     m = serving_mesh()
     if m is None:
         return jnp.asarray(x)
-    from ..parallel.mesh import batch_sharding
+    from ..parallel.mesh import MESH_AFFINITY, batch_placement
     B, _, S = x.shape
-    return jax.device_put(x, batch_sharding(m, B, S))
+    sh, dev_indices = batch_placement(m, B, S, affinity)
+    MESH_AFFINITY.record_dispatch(dev_indices, x.nbytes)
+    return jax.device_put(x, sh)
+
+
+def pinned_device(B: int, S: int, affinity: int | None) -> int | None:
+    """Device index a (B, ·, S) batch will be pinned to under the
+    current mesh placement, or None when it shards/replicates."""
+    m = serving_mesh()
+    if m is None or affinity is None:
+        return None
+    from ..parallel.mesh import batch_placement
+    _, dev_indices = batch_placement(m, B, S, affinity)
+    return dev_indices[0] if len(dev_indices) == 1 else None
+
+
+def batch_home_device(x, affinity: int | None) -> int | None:
+    """pinned_device for an actual (B, R, S) array — the GF matrix
+    must be placed WHERE the batch lives (a mesh-replicated matrix
+    against a single-device operand is a jit placement error)."""
+    return pinned_device(x.shape[0], x.shape[-1], affinity)
 
 
 def device_put_replicated(x):
@@ -186,28 +225,35 @@ def device_put_replicated(x):
 
 def _device_reconstruct(stack: np.ndarray, k: int, m: int,
                         avail: tuple[int, ...], missing: tuple[int, ...],
-                        ) -> np.ndarray:
+                        affinity: int | None = None) -> np.ndarray:
     from . import rs_tpu
     from ..obs.kernel_stats import KERNEL, RS_DECODE, timed
-    bm = rs_tpu._placed_any_decode(k, m, avail, missing, serving_mesh())
+    bm = rs_tpu._placed_any_decode(k, m, avail, missing, serving_mesh(),
+                                   batch_home_device(stack, affinity))
     with timed() as t:
-        out = np.asarray(rs_tpu.gf_apply(bm, device_put_batch(stack)))
+        out = np.asarray(rs_tpu.gf_apply(
+            bm, device_put_batch(stack, affinity)))
     KERNEL.record(RS_DECODE, True, stack.nbytes, t.s,
                   blocks=stack.shape[0], backend=attempt_backend())
     return out
 
 
 def host_apply_tagged(mat: np.ndarray, cols: np.ndarray,
+                      lane: str | None = None,
                       ) -> tuple[np.ndarray, str]:
     """host_apply plus which backend actually ran (kernprof NATIVE
     when the C++ kernel answered, HOST for the numpy table-gather) —
-    the per-dispatch profile must not lump them: they differ ~10x."""
+    the per-dispatch profile must not lump them: they differ ~10x.
+    ``lane`` (from the autotuner plan) pins pure-numpy when the
+    measured model says so; default is native-first with numpy
+    fallback, exactly as before."""
     from ..obs.kernprof import HOST, NATIVE
-    from ..native import rs_apply_native
-    out = rs_apply_native(mat, cols)
-    if out is None:
-        return gf_mat_vec_apply(mat, cols), HOST
-    return out, NATIVE
+    if lane != HOST:
+        from ..native import rs_apply_native
+        out = rs_apply_native(mat, cols)
+        if out is not None:
+            return out, NATIVE
+    return gf_mat_vec_apply(mat, cols), HOST
 
 
 def host_apply(mat: np.ndarray, cols: np.ndarray) -> np.ndarray:
@@ -217,7 +263,8 @@ def host_apply(mat: np.ndarray, cols: np.ndarray) -> np.ndarray:
     return host_apply_tagged(mat, cols)[0]
 
 
-def _host_reconstruct(stack: np.ndarray, mat: np.ndarray) -> np.ndarray:
+def _host_reconstruct(stack: np.ndarray, mat: np.ndarray,
+                      lane: str | None = None) -> np.ndarray:
     """(B, n_used, S) -> (B, n_missing, S) via one folded apply.
 
     RS is byte-column-independent, so the batch dim folds into the
@@ -227,7 +274,7 @@ def _host_reconstruct(stack: np.ndarray, mat: np.ndarray) -> np.ndarray:
     B, n_used, S = stack.shape
     with timed() as t:
         cols = stack.transpose(1, 0, 2).reshape(n_used, B * S)
-        out, backend = host_apply_tagged(mat, cols)
+        out, backend = host_apply_tagged(mat, cols, lane)
         out = out.reshape(mat.shape[0], B, S).transpose(1, 0, 2)
     KERNEL.record(RS_DECODE, False, stack.nbytes, t.s, blocks=B,
                   backend=backend)
@@ -237,6 +284,7 @@ def _host_reconstruct(stack: np.ndarray, mat: np.ndarray) -> np.ndarray:
 def reconstruct_blocks(blocks: list[list[np.ndarray | None]], k: int,
                        m: int, *, want_all: bool, use_device,
                        device_fallback: bool = True,
+                       affinity: int | None = None,
                        ) -> list[list[np.ndarray | None]]:
     """Rebuild missing shards across many blocks, one dispatch per mask.
 
@@ -291,7 +339,7 @@ def reconstruct_blocks(blocks: list[list[np.ndarray | None]], k: int,
                     from ..faultinject import FAULTS
                     FAULTS.kernel("rs_decode")
                     rebuilt = _device_reconstruct(stack, k, m, avail,
-                                                  missing)
+                                                  missing, affinity)
                     STATS.add(True, stack.nbytes, len(idxs))
                 except Exception as exc:
                     if not device_fallback:
@@ -300,7 +348,11 @@ def reconstruct_blocks(blocks: list[list[np.ndarray | None]], k: int,
                     rebuilt = _host_reconstruct(stack, mat)
                     STATS.add(False, stack.nbytes, len(idxs))
             else:
-                rebuilt = _host_reconstruct(stack, mat)
+                from .autotune import AUTOTUNE
+                from .autotune import RS_DECODE as _RSD
+                rebuilt = _host_reconstruct(
+                    stack, mat, lane=AUTOTUNE.host_lane(_RSD,
+                                                        stack.nbytes))
                 STATS.add(False, stack.nbytes, len(idxs))
         for bn, bi in enumerate(idxs):
             for mi, j in enumerate(missing):
@@ -311,7 +363,8 @@ def reconstruct_blocks(blocks: list[list[np.ndarray | None]], k: int,
 # --- cross-request encode coalescing -----------------------------------------
 
 
-def host_encode(blocks: np.ndarray, k: int, m: int) -> np.ndarray:
+def host_encode(blocks: np.ndarray, k: int, m: int,
+                lane: str | None = None) -> np.ndarray:
     """(B, k, S) -> (B, k+m, S) on the host, counted in STATS.
 
     The batch folds into the columns of ONE matrix apply (native C++
@@ -324,7 +377,8 @@ def host_encode(blocks: np.ndarray, k: int, m: int) -> np.ndarray:
         out = np.zeros((B, k + m, S), dtype=np.uint8)
         out[:, :k] = blocks
         cols = blocks.transpose(1, 0, 2).reshape(k, B * S)
-        parity, backend = host_apply_tagged(parity_matrix(k, m), cols)
+        parity, backend = host_apply_tagged(parity_matrix(k, m), cols,
+                                            lane)
         out[:, k:] = parity.reshape(m, B, S).transpose(1, 0, 2)
     STATS.add(False, blocks.nbytes)
     KERNEL.record(RS_ENCODE, False, blocks.nbytes, t.s, blocks=B,
@@ -332,8 +386,8 @@ def host_encode(blocks: np.ndarray, k: int, m: int) -> np.ndarray:
     return out
 
 
-def host_encode_shardmajor(blocks: np.ndarray, k: int,
-                           m: int) -> np.ndarray:
+def host_encode_shardmajor(blocks: np.ndarray, k: int, m: int,
+                           lane: str | None = None) -> np.ndarray:
     """(B, k, S) -> SHARD-MAJOR (k+m, B, S) contiguous, on the host.
 
     Same bytes as host_encode transposed, but two full-batch copies
@@ -347,7 +401,8 @@ def host_encode_shardmajor(blocks: np.ndarray, k: int,
         out = np.empty((k + m, B, S), dtype=np.uint8)
         out[:k] = blocks.transpose(1, 0, 2)
         parity, backend = host_apply_tagged(parity_matrix(k, m),
-                                            out[:k].reshape(k, B * S))
+                                            out[:k].reshape(k, B * S),
+                                            lane)
         out[k:] = parity.reshape(m, B, S)
     STATS.add(False, blocks.nbytes)
     KERNEL.record(RS_ENCODE, False, blocks.nbytes, t.s, blocks=B,
@@ -360,6 +415,10 @@ class _EncodeRequest:
     blocks: np.ndarray  # (B, k, S) uint8 data shards
     k: int
     m: int
+    # Home device of the submitting erasure set (parallel/mesh.py
+    # DeviceAffinity): a coalesced window whose requests span >= 2
+    # home devices fans out as parallel per-device dispatches.
+    affinity: int | None = None
     done: threading.Event = field(default_factory=threading.Event)
     result: np.ndarray | None = None
     declined: bool = False
@@ -390,7 +449,8 @@ class EncodeCoalescer:
         self._lock = threading.Lock()
         self._stopped = False
 
-    def encode(self, blocks: np.ndarray, k: int, m: int) -> np.ndarray:
+    def encode(self, blocks: np.ndarray, k: int, m: int,
+               affinity: int | None = None) -> np.ndarray:
         """Blocking encode: (B, k, S) data -> (B, k+m, S) all shards.
 
         Priority lanes (qos/scheduler.py): a background caller (heal,
@@ -401,7 +461,8 @@ class EncodeCoalescer:
         from ..qos import scheduler as qos_sched
         with qos_sched.GATE.dispatch(qos_sched.current_lane()):
             req = _EncodeRequest(
-                np.ascontiguousarray(blocks, dtype=np.uint8), k, m)
+                np.ascontiguousarray(blocks, dtype=np.uint8), k, m,
+                affinity)
             self._ensure_thread()
             self._q.put(req)
             # Liveness-checked wait: if the dispatcher dies (or a
@@ -496,9 +557,20 @@ class EncodeCoalescer:
                 # host-encode lane — the failover under test.
                 from ..faultinject import FAULTS
                 FAULTS.kernel("rs_encode")
-                stack = (reqs[0].blocks if len(reqs) == 1 else
-                         np.concatenate([r.blocks for r in reqs], axis=0))
-                encoded = rs_tpu.encode_batch(stack, k, m)
+                by_dev = self._fanout_split(reqs)
+                if by_dev is not None:
+                    self._fanout_encode(by_dev, k, m)
+                else:
+                    stack = (reqs[0].blocks if len(reqs) == 1 else
+                             np.concatenate([r.blocks for r in reqs],
+                                            axis=0))
+                    encoded = rs_tpu.encode_batch(
+                        stack, k, m, affinity=reqs[0].affinity)
+                    off = 0
+                    for r in reqs:
+                        B = r.blocks.shape[0]
+                        r.result = encoded[off:off + B]
+                        off += B
                 STATS.add(True, total, len(reqs))
                 if len(reqs) > 1:
                     # rs_tpu.encode_batch counted the dispatch itself;
@@ -506,11 +578,6 @@ class EncodeCoalescer:
                     # is only visible here.
                     from ..obs.kernel_stats import KERNEL, RS_ENCODE
                     KERNEL.record_coalesced(RS_ENCODE, len(reqs))
-                off = 0
-                for r in reqs:
-                    B = r.blocks.shape[0]
-                    r.result = encoded[off:off + B]
-                    off += B
             except BaseException as exc:
                 device_dispatch_failed(exc)
                 for r in reqs:
@@ -519,42 +586,125 @@ class EncodeCoalescer:
                 for r in reqs:
                     r.done.set()
 
+    @staticmethod
+    def _fanout_split(reqs: list[_EncodeRequest],
+                      ) -> dict[int, list[_EncodeRequest]] | None:
+        """Group a coalesced window's requests by home device.
+
+        >= 2 distinct home devices on a live serving mesh, AND every
+        sub-batch actually PINS to its home device -> the window fans
+        out as parallel per-device dispatches (one encode per chip,
+        request boundaries split the batch cleanly by construction).
+        A sub-batch an axis of which divides the mesh would shard
+        across ALL chips instead — fanning those out turns one
+        combined mesh dispatch into N contending ones, so the split
+        is declined.  None = no clean split: single request, shared
+        or absent affinity, no mesh, or mesh-divisible sub-batches —
+        the caller falls back to one dispatch, mesh-sharded by
+        device_put_batch when B divides."""
+        if len(reqs) < 2 or serving_mesh() is None:
+            return None
+        from ..parallel.mesh import MESH_AFFINITY
+        n_dev = MESH_AFFINITY.n_devices()
+        by: dict[int, list[_EncodeRequest]] = {}
+        for r in reqs:
+            if r.affinity is None:
+                return None
+            # Group by EFFECTIVE device: after a device-count shrink,
+            # two sets' stale raw indices can alias (mod n) onto one
+            # chip — "fanning out" those as separate dispatches would
+            # serialize them on the same device while the metric
+            # claimed a spread.
+            by.setdefault(r.affinity % max(1, n_dev), []).append(r)
+        if len(by) < 2:
+            return None
+        for dev, sub in by.items():
+            B = sum(r.blocks.shape[0] for r in sub)
+            S = sub[0].blocks.shape[-1]
+            if pinned_device(B, S, dev) is None:
+                return None
+        return by
+
+    @staticmethod
+    def _fanout_encode(by_dev: dict[int, list[_EncodeRequest]],
+                       k: int, m: int) -> None:
+        """Parallel per-device encode of a fanned-out window; each
+        request's result lands byte-identical to the single-dispatch
+        path (encode is per-block independent — proven by the
+        8-virtual-device merge tests).  Any sub-dispatch failure
+        propagates so the whole window declines to host encode."""
+        from . import rs_tpu
+        from ..parallel.quorum import parallel_map
+
+        def enc(dev: int, sub: list[_EncodeRequest]) -> None:
+            stack = (sub[0].blocks if len(sub) == 1 else
+                     np.concatenate([r.blocks for r in sub], axis=0))
+            encoded = rs_tpu.encode_batch(stack, k, m, affinity=dev)
+            off = 0
+            for r in sub:
+                B = r.blocks.shape[0]
+                r.result = encoded[off:off + B]
+                off += B
+
+        subs = sorted(by_dev.items())
+        _, errs = parallel_map(
+            [lambda d=dev, s=sub: enc(d, s) for dev, sub in subs])
+        for e in errs:
+            if e is not None:
+                raise e
+        from ..obs.metrics2 import METRICS2
+        METRICS2.inc("minio_tpu_v2_codec_plan_fanout_total",
+                     {"devices": str(len(subs))})
+
 
 _global_coalescer: EncodeCoalescer | None = None
 _global_lock = threading.Lock()
 
 
 def default_device_policy(nbytes: int) -> bool:
-    """Device when present and the coalesced batch is big enough to
-    amortize dispatch latency."""
-    from ..erasure import codec as _codec
-    if nbytes < _codec.TPU_MIN_BYTES:
-        return False
-    return device_present()
+    """Jit-lane policy for the shared coalescer: the MEASURED plan
+    (ops/autotune.py) — static device-first fallback until the probe
+    ladder has run.  The hardwired TPU_MIN_BYTES comparison that used
+    to live here is gone (mtpu-lint R9 keeps it gone)."""
+    from .autotune import AUTOTUNE, RS_ENCODE
+    return AUTOTUNE.use_jit_lane(RS_ENCODE, nbytes)
 
 
 _device_present: bool | None = None
+_device_count: int | None = None
 
 
 def device_present() -> bool:
-    global _device_present
+    global _device_present, _device_count
     if _device_present is None:
         try:
             import jax
-            _device_present = any(
-                d.platform != "cpu" for d in jax.devices())
+            devs = jax.devices()
+            _device_present = any(d.platform != "cpu" for d in devs)
+            _device_count = len(devs)
         except Exception:
             _device_present = False
+            _device_count = 1
     return _device_present
 
 
 def reprobe_device_present() -> bool:
     """Drop the cached device census and re-ask jax — the kernprof
     DEVICE recovery probe's entry point, so a relay that bounced back
-    mid-process is re-adopted without a restart."""
+    mid-process is re-adopted without a restart.  A relay that comes
+    back with a DIFFERENT device count must not keep dispatching over
+    the stale mesh: the serving mesh is rebuilt and the autotuner
+    re-probes + re-plans on a census change."""
     global _device_present
+    old_count = _device_count
     _device_present = None
-    return device_present()
+    present = device_present()
+    if old_count is not None and _device_count != old_count:
+        reset_serving_mesh()
+        from .autotune import AUTOTUNE
+        AUTOTUNE.on_device_census_change(old_count,
+                                         _device_count or 1)
+    return present
 
 
 def get_coalescer() -> EncodeCoalescer:

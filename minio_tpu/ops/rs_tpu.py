@@ -70,21 +70,36 @@ def any_decode_bitplane(k: int, m: int, available: tuple[int, ...],
 
 
 @lru_cache(maxsize=1024)
-def _placed_parity(k: int, m: int, mesh) -> "jnp.ndarray":
+def _placed_parity(k: int, m: int, mesh,
+                   device_index: int | None = None) -> "jnp.ndarray":
     """parity_bitplane already cached host-side; this caches the
-    DEVICE-PLACED (mesh-replicated) copy so the hot PUT path doesn't
-    re-transfer the matrix on every dispatch (mesh is hashable; None on
-    a single device)."""
+    DEVICE-PLACED copy so the hot PUT path doesn't re-transfer the
+    matrix on every dispatch (mesh is hashable; None on a single
+    device).  ``device_index`` pins the matrix to the batch's home
+    device when the batch itself is affinity-pinned — a mesh-
+    replicated matrix against a single-device operand is a jit
+    placement error."""
     from . import batching
+    if device_index is not None:
+        return _device_pinned(parity_bitplane(k, m), device_index)
     return batching.device_put_replicated(parity_bitplane(k, m))
 
 
 @lru_cache(maxsize=1024)
 def _placed_any_decode(k: int, m: int, available: tuple[int, ...],
-                       missing: tuple[int, ...], mesh) -> "jnp.ndarray":
+                       missing: tuple[int, ...], mesh,
+                       device_index: int | None = None,
+                       ) -> "jnp.ndarray":
     from . import batching
     bm, _ = any_decode_bitplane(k, m, available, missing)
+    if device_index is not None:
+        return _device_pinned(bm, device_index)
     return batching.device_put_replicated(bm)
+
+
+def _device_pinned(x: np.ndarray, device_index: int) -> "jnp.ndarray":
+    devs = jax.devices()
+    return jax.device_put(x, devs[device_index % len(devs)])
 
 
 # --- device kernel ------------------------------------------------------------
@@ -184,6 +199,12 @@ def _dispatch(pallas_fn, pallas_sharded_fn, xla_fn, big_m, x):
             if mesh is None:
                 return pallas_fn(big_m, x)
             if getattr(x, "ndim", 0) == 3:
+                sh = getattr(x, "sharding", None)
+                if sh is not None and len(sh.device_set) == 1:
+                    # Affinity-pinned batch: the whole batch lives on
+                    # one chip (parallel/mesh.batch_placement) — run
+                    # the packed kernel there directly, no shard_map.
+                    return pallas_fn(big_m, x)
                 return pallas_sharded_fn(mesh, big_m, x)
         except ValueError:
             raise
@@ -225,17 +246,22 @@ def encode_blocks(big_m: jnp.ndarray, data: jnp.ndarray) -> jnp.ndarray:
 # --- convenience host API -----------------------------------------------------
 
 
-def encode_batch(data: np.ndarray, k: int, m: int) -> np.ndarray:
+def encode_batch(data: np.ndarray, k: int, m: int,
+                 affinity: int | None = None) -> np.ndarray:
     """Encode a (B, k, S) or (k, S) uint8 batch on the device(s) —
-    batches spread across the serving mesh when >1 device is visible
-    (ops/batching.device_put_batch). Every dispatch lands in the
-    metrics-v2 kernel counters (invocations/bytes/wall/occupancy)."""
+    batches spread across the serving mesh when >1 device is visible,
+    or land whole on the owning set's home device (``affinity``) when
+    they don't divide it (ops/batching.device_put_batch). Every
+    dispatch lands in the metrics-v2 kernel counters
+    (invocations/bytes/wall/occupancy)."""
     from . import batching
     from ..obs.kernel_stats import KERNEL, RS_ENCODE, timed
-    bm = _placed_parity(k, m, batching.serving_mesh())
+    home = (batching.batch_home_device(data, affinity)
+            if data.ndim == 3 else None)
+    bm = _placed_parity(k, m, batching.serving_mesh(), home)
     with timed() as t:
         if data.ndim == 3:
-            placed = batching.device_put_batch(data)
+            placed = batching.device_put_batch(data, affinity)
         else:
             placed = jnp.asarray(data)
         out = np.asarray(encode_blocks(bm, placed))

@@ -17,9 +17,11 @@ a virtual CPU mesh (tests) and via __graft_entry__.dryrun_multichip.
 from __future__ import annotations
 
 import math
+import threading
 
 import jax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import (Mesh, NamedSharding, PartitionSpec as P,
+                          SingleDeviceSharding)
 
 
 def make_mesh(n_devices: int | None = None,
@@ -68,3 +70,105 @@ def rows_sharding(mesh: Mesh, B: int, ndim: int) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def batch_placement(mesh: Mesh, B: int, S: int,
+                    affinity: int | None = None,
+                    ) -> tuple[object, tuple[int, ...]]:
+    """(sharding, device indices) for a (B, k, S) serving batch.
+
+    Divisible axes shard across the mesh exactly like
+    ``batch_sharding``.  A batch NEITHER axis of which divides used to
+    replicate to every chip (each one redundantly computing the whole
+    thing); with a per-set ``affinity`` it now lands WHOLE on the
+    owning erasure set's home device, so concurrent sets' small
+    dispatches spread across chips instead of all queueing on device
+    0.  The device-index tuple is what the dispatch actually occupies
+    — fed to ``MESH_AFFINITY.record_dispatch`` so the spread is
+    provable, not aspirational."""
+    sh = batch_sharding(mesh, B, S)  # the one divisibility rule
+    if affinity is not None and sh.spec == P(None, None, None):
+        devs = jax.devices()
+        idx = affinity % len(devs)
+        return SingleDeviceSharding(devs[idx]), (idx,)
+    return sh, tuple(range(mesh.size))
+
+
+class DeviceAffinity:
+    """Per-erasure-set home-device assignment + per-device dispatch
+    census (``MESH_AFFINITY``).
+
+    Each ``ErasureObjects`` set registers at construction and gets the
+    next device round-robin; every placed dispatch records which
+    device indices it occupied.  The census is the proof behind the
+    admin ``/codec-plan`` affinity map and the 8-virtual-device spread
+    tests — per-set affinity is only real if the counters say so."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._assign: dict[str, int] = {}
+        self._next = 0
+        self._dispatches: dict[int, int] = {}
+        self._bytes: dict[int, int] = {}
+
+    @staticmethod
+    def n_devices() -> int:
+        try:
+            return len(jax.devices())
+        except Exception:
+            return 1
+
+    def assign(self, owner: str) -> int | None:
+        """Home device index for `owner` (idempotent); None on a
+        single-device box — affinity only means something on a mesh."""
+        n = self.n_devices()
+        if n <= 1:
+            return None
+        with self._mu:
+            idx = self._assign.get(owner)
+            if idx is None:
+                idx = self._next % n
+                self._next += 1
+                self._assign[owner] = idx
+            return idx
+
+    def release(self, owner: str) -> None:
+        with self._mu:
+            self._assign.pop(owner, None)
+
+    def record_dispatch(self, device_indices: tuple[int, ...],
+                        nbytes: int) -> None:
+        with self._mu:
+            for i in device_indices:
+                self._dispatches[i] = self._dispatches.get(i, 0) + 1
+                self._bytes[i] = self._bytes.get(i, 0) + nbytes
+
+    def counters(self) -> dict[int, dict]:
+        with self._mu:
+            return {i: {"dispatches": self._dispatches.get(i, 0),
+                        "bytes": self._bytes.get(i, 0)}
+                    for i in sorted(set(self._dispatches)
+                                    | set(self._bytes))}
+
+    def snapshot(self) -> dict:
+        """The affinity map the admin /codec-plan serves."""
+        with self._mu:
+            return {
+                "nDevices": self.n_devices(),
+                "assignments": dict(sorted(self._assign.items())),
+                "dispatches": {
+                    str(i): {"dispatches": self._dispatches.get(i, 0),
+                             "bytes": self._bytes.get(i, 0)}
+                    for i in sorted(set(self._dispatches)
+                                    | set(self._bytes))},
+            }
+
+    def reset(self) -> None:
+        with self._mu:
+            self._assign.clear()
+            self._next = 0
+            self._dispatches.clear()
+            self._bytes.clear()
+
+
+MESH_AFFINITY = DeviceAffinity()

@@ -655,6 +655,29 @@ class AdminHandlers:
         out.update(KERNPROF.snapshot())
         return out
 
+    def h_codec_plan(self, p, body):
+        """Codec dispatch planner (ops/autotune.py): the live plan per
+        (kernel, batch-size bucket), the measured per-lane crossover
+        table (GiB/s + sample counts), probe-ladder results, backend
+        health states, and the per-set device-affinity map with its
+        per-device dispatch census (parallel/mesh.py).  ``?probe=
+        true`` re-runs the probe ladder synchronously first — the
+        manual 'is the crossover still right?' lever (probes are tiny
+        real dispatches; root-only surface, no amplification risk)."""
+        from ..ops.autotune import AUTOTUNE
+        out: dict = {}
+        if p.get("probe") == "true":
+            # Keyed apart from snapshot()'s boolean "probed" flag.
+            out["probeResults"] = AUTOTUNE.probe_ladder()
+        out.update(AUTOTUNE.snapshot())
+        try:
+            from ..parallel.mesh import MESH_AFFINITY
+            out["affinity"] = MESH_AFFINITY.snapshot()
+        except Exception:
+            out["affinity"] = {"nDevices": 1, "assignments": {},
+                               "dispatches": {}}
+        return out
+
     def h_incidents(self, p, body):
         """Incident bundles (obs/incidents.py): auto-frozen diagnosis
         state for every alert that reached firing.  Bare GET lists the

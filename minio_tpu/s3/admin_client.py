@@ -202,6 +202,13 @@ class AdminClient:
     def cache_stats(self) -> dict:
         return self._call("GET", "cache-stats")
 
+    def codec_plan(self, probe: bool = False) -> dict:
+        """Codec dispatch planner view (ops/autotune.py): live plan,
+        measured crossover table, probe results, device-affinity map.
+        probe=True re-runs the probe ladder synchronously first."""
+        p = {"probe": "true"} if probe else {}
+        return self._call("GET", "codec-plan", p)
+
     def replication_stats(self) -> dict:
         return self._call("GET", "replication-stats")
 

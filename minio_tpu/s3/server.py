@@ -2270,6 +2270,22 @@ class S3Server:
             if kvs.get("json") not in (None, "on", "off"):
                 raise ValueError(
                     f"logger json={kvs.get('json')!r}: must be on/off")
+        if subsys == "codec":
+            for key, v in kvs.items():
+                if key in ("autotune", "probe_on_boot"):
+                    if v not in ("on", "off"):
+                        raise ValueError(
+                            f"codec {key}={v!r}: must be on/off")
+                elif key == "hysteresis":
+                    try:
+                        # NaN-proof: `not (x >= 1.0)` rejects NaN
+                        # where `x < 1.0` would wave it through.
+                        if not (float(v) >= 1.0):
+                            raise ValueError
+                    except ValueError:
+                        raise ValueError(
+                            f"codec hysteresis={v!r}: must be a "
+                            "number >= 1.0")
         if subsys == "alerts":
             from ..obs.watchdog import validate_user_rules
             from ..qos.deadline import parse_duration
@@ -2589,6 +2605,22 @@ class S3Server:
                 Logger.get().log_once(
                     f"alerts config invalid, keeping previous: {e}",
                     "config")
+        # Codec autotuner knobs reload live (ops/autotune.py):
+        # autotune=off pins the static policy, hysteresis retunes the
+        # plan-flip margin.
+        from ..ops.autotune import AUTOTUNE
+        try:
+            _hyst = float(cfg.get("codec", "hysteresis"))
+            if not (_hyst >= 1.0):  # env bypasses _validate; NaN-proof
+                raise ValueError("hysteresis must be >= 1.0")
+            AUTOTUNE.configure(
+                enabled=cfg.get("codec", "autotune") == "on",
+                hysteresis=_hyst)
+        except ValueError as e:  # env override may carry garbage
+            from ..logger import Logger
+            Logger.get().log_once(
+                f"codec config invalid, keeping previous: {e}",
+                "config")
         # Structured JSON log mode; the legacy MINIO_LOG_JSON env
         # spelling wins over config (env-first, like every subsystem).
         import os as _os_log
@@ -3900,6 +3932,21 @@ class S3Server:
         from ..obs.timeline import TIMELINE
         TIMELINE.start()
         self._timeline_started = True
+        # Codec autotuner boot probe ladder (ops/autotune.py): one
+        # background run per process — tiny known-answer dispatches
+        # seeding the measured per-lane crossover; serving starts on
+        # the static policy and flips to the plan when the ladder
+        # lands (codec probe_on_boot=off skips it; the plan then
+        # builds from live dispatch samples only).
+        try:
+            probe_on_boot = (self.config is None
+                             or self.config.get(
+                                 "codec", "probe_on_boot") == "on")
+        except Exception:
+            probe_on_boot = True
+        if probe_on_boot:
+            from ..ops.autotune import AUTOTUNE
+            AUTOTUNE.ensure_probed(background=True)
         # Incident bundles capture server-scoped context (effective
         # config, MRF census) through providers — the recorder itself
         # stays server-agnostic.

@@ -117,7 +117,7 @@ def test_any_decode_matrix_parity_rows():
 
 def _force_tpu(monkeypatch):
     """Route every codec decision through the device path (CPU-jax)."""
-    monkeypatch.setattr(Erasure, "_use_tpu", lambda self, n: True)
+    monkeypatch.setattr(Erasure, "_use_tpu", lambda self, *a: True)
 
 
 def test_engine_get_with_loss_is_coalesced_device_dispatch(

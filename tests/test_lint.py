@@ -26,10 +26,12 @@ from tools.mtpu_lint.core import ModuleCtx, run
 from tools.mtpu_lint.rules.asyncblocking import AsyncBlockingRule
 from tools.mtpu_lint.rules.commits import CommitReplaceRule
 from tools.mtpu_lint.rules.concurrency import ThreadCtxRule
+from tools.mtpu_lint.rules.dispatch import DispatchPolicyRule
 from tools.mtpu_lint.rules.errormap import ErrorMapRule
 from tools.mtpu_lint.rules.kernels import KernelPurityRule
 from tools.mtpu_lint.rules.locks import BlockingUnderLockRule
-from tools.mtpu_lint.rules.obs import (KernprofTimelineMetricCallRule,
+from tools.mtpu_lint.rules.obs import (AutotuneMetricCallRule,
+                                       KernprofTimelineMetricCallRule,
                                        MetricNameRule, NativeAssertRule,
                                        QosMetricCallRule,
                                        WatchdogIncidentMetricCallRule)
@@ -558,6 +560,73 @@ def test_r8_scoped_to_s3_package_with_waiver_escape():
 
 
 # ---------------------------------------------------------------------------
+# R9 — backend-selection policy lives in ops/autotune.py
+
+
+def test_r9_flags_hardwired_threshold_and_lane_literal():
+    src = (
+        "TPU_MIN_BYTES = 4 * 1024 * 1024\n"
+        "def pick(nbytes, lane):\n"
+        "    if nbytes < TPU_MIN_BYTES:\n"
+        "        return False\n"
+        "    if lane == 'device':\n"
+        "        return True\n"
+        "    return nbytes >= 8388608\n")
+    found = _check(DispatchPolicyRule(), src,
+                   "minio_tpu/ops/sample.py")
+    msgs = [f.message for f in found]
+    assert len(found) == 3
+    assert any("size threshold" in m for m in msgs)
+    assert any("lane literal" in m for m in msgs)
+    assert any("inline byte-size crossover" in m for m in msgs)
+    # Same violations flagged in the codec module too.
+    assert len(_check(DispatchPolicyRule(), src,
+                      "minio_tpu/erasure/codec.py")) == 3
+
+
+def test_r9_exempts_autotune_and_out_of_scope_trees():
+    src = ("def pick(nbytes):\n"
+           "    return nbytes >= 4194304\n")
+    rule = DispatchPolicyRule()
+    # The planner itself is the sanctioned home of the threshold.
+    assert not rule.applies(_ctx(src, "minio_tpu/ops/autotune.py"))
+    # bitrot/heal/engine are not dispatch-decision modules for R9.
+    assert not rule.applies(_ctx(src, "minio_tpu/erasure/bitrot.py"))
+    assert not rule.applies(_ctx(src, "minio_tpu/s3/server.py"))
+
+
+def test_r9_accepts_pins_and_constant_identity():
+    """User-facing codec pins ("tpu"/"cpu") and comparisons through
+    the imported kernprof constants stay legal — identity is fine,
+    inline POLICY is not."""
+    src = (
+        "from minio_tpu.obs.kernprof import DEVICE\n"
+        "def pick(backend, lane, n_blocks):\n"
+        "    if backend == 'tpu':\n"
+        "        return True\n"
+        "    if backend == 'cpu':\n"
+        "        return False\n"
+        "    if lane == DEVICE:\n"
+        "        return True\n"
+        "    return n_blocks > 4\n")
+    assert _check(DispatchPolicyRule(), src,
+                  "minio_tpu/ops/sample.py") == []
+
+
+def test_r9_waiver_escape_hatch():
+    src = (
+        "def pick(nbytes):\n"
+        "    # mtpu-lint: disable=R9 -- probe rung floor, not a dispatch crossover\n"
+        "    return nbytes >= 4194304\n")
+    ctx = _ctx(src, "minio_tpu/ops/sample.py")
+    raw = DispatchPolicyRule().check(ctx)
+    assert len(raw) == 1  # fires pre-suppression…
+    waived_lines = {s.line for s in ctx.suppressions
+                    if "R9" in s.rules}
+    assert all(f.line in waived_lines for f in raw)  # …and is waived
+
+
+# ---------------------------------------------------------------------------
 # O-rules (ported obs_lint) — representative positive/negative pairs;
 # tests/test_observability.py keeps the original shim-level coverage.
 
@@ -642,6 +711,31 @@ def test_o7_watchdog_incidents_literal_recording_calls():
     # Out of scope: the rule does not apply elsewhere in obs/.
     assert not WatchdogIncidentMetricCallRule().applies(
         _ctx(bad, "minio_tpu/obs/slowlog.py"))
+
+
+def test_o8_autotune_literal_recording_calls():
+    # POSITIVE: dynamic name + unregistered codec_plan literal.
+    bad = ("def f(name):\n"
+           "    METRICS2.inc(name)\n"
+           "    METRICS2.set_gauge('minio_tpu_v2_codec_plan_bogus',"
+           " {'kernel': 'rs_encode'}, 1)\n")
+    assert len(_check(AutotuneMetricCallRule(), bad,
+                      "minio_tpu/ops/autotune.py")) == 2
+    # NEGATIVE: the real codec_plan_* series are registered.
+    good = ("def f():\n"
+            "    METRICS2.set_gauge('minio_tpu_v2_codec_plan_lane',"
+            " {'kernel': 'rs_encode', 'bucket': '<64K'}, 1)\n"
+            "    METRICS2.inc("
+            "'minio_tpu_v2_codec_plan_transitions_total',"
+            " {'kernel': 'rs_encode', 'bucket': '<64K',"
+            " 'lane': 'native'})\n"
+            "    METRICS2.inc('minio_tpu_v2_codec_plan_probes_total',"
+            " {'lane': 'native', 'result': 'pass'})\n")
+    assert _check(AutotuneMetricCallRule(), good,
+                  "minio_tpu/ops/autotune.py") == []
+    # Out of scope: the rule does not apply elsewhere in ops/.
+    assert not AutotuneMetricCallRule().applies(
+        _ctx(bad, "minio_tpu/ops/batching.py"))
 
 
 # ---------------------------------------------------------------------------

@@ -68,7 +68,7 @@ def _make_engine(tmp_path, n=6, block_size=8192):
 
 
 def _force_tpu(monkeypatch):
-    monkeypatch.setattr(Erasure, "_use_tpu", lambda self, n: True)
+    monkeypatch.setattr(Erasure, "_use_tpu", lambda self, *a: True)
 
 
 def test_engine_put_get_loss_heal_on_mesh(tmp_path, monkeypatch):

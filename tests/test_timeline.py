@@ -385,7 +385,7 @@ def test_backend_flip_visible_in_all_three_sinks(server, monkeypatch):
     assert victim
     import shutil
     shutil.rmtree(os.path.join(victim, "flip", "obj"))
-    monkeypatch.setattr(Erasure, "_use_tpu", lambda self, n: True)
+    monkeypatch.setattr(Erasure, "_use_tpu", lambda self, *a: True)
     backend = batching.attempt_backend()
 
     plan = json.dumps({"rules": [{"kind": "kernel",

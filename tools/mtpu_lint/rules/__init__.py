@@ -5,10 +5,11 @@ from __future__ import annotations
 from .asyncblocking import AsyncBlockingRule
 from .commits import CommitReplaceRule
 from .concurrency import ThreadCtxRule
+from .dispatch import DispatchPolicyRule
 from .errormap import ErrorMapRule
 from .kernels import KernelPurityRule
 from .locks import BlockingUnderLockRule
-from .obs import (DrivemonSlowlogMetricCallRule,
+from .obs import (AutotuneMetricCallRule, DrivemonSlowlogMetricCallRule,
                   KernprofTimelineMetricCallRule, MetricNameRule,
                   NativeAssertRule, PipelineMetricCallRule,
                   QosMetricCallRule, WatchdogIncidentMetricCallRule)
@@ -26,6 +27,7 @@ def all_rules():
         BoundedRetryRule(),
         CommitReplaceRule(),
         AsyncBlockingRule(),
+        DispatchPolicyRule(),
         NativeAssertRule(),
         MetricNameRule(),
         QosMetricCallRule(),

@@ -11,6 +11,7 @@ O4  utils/pipeline.py recording calls pass literal registered names
 O5  obs/drivemon.py + obs/slowlog.py recording calls likewise
 O6  obs/kernprof.py + obs/timeline.py recording calls likewise
 O7  obs/watchdog.py + obs/incidents.py recording calls likewise
+O8  ops/autotune.py recording calls likewise (codec_plan_* series)
 """
 
 from __future__ import annotations
@@ -144,3 +145,10 @@ class WatchdogIncidentMetricCallRule(_LiteralCallRule):
     title = "watchdog/incident metric recordings use literal registered names"
     what = "watchdog/incidents"
     paths = ("minio_tpu/obs/watchdog.py", "minio_tpu/obs/incidents.py")
+
+
+class AutotuneMetricCallRule(_LiteralCallRule):
+    id = "O8"
+    title = "autotune metric recordings use literal registered names"
+    what = "autotune"
+    paths = ("minio_tpu/ops/autotune.py",)

@@ -32,6 +32,12 @@ import urllib.request
 SPARK = "▁▂▃▄▅▆▇█"
 _CLASSES = ("read", "write", "list", "admin")
 _STATE_NAMES = {0: "UP", 1: "DEGRADED", 2: "DOWN"}
+# Codec-plan lane indices (ops/autotune.py plan_indices order =
+# kernprof BACKENDS), abbreviated for the one-line codec row.
+_LANE_ABBREV = {0: "dev", 1: "nat", 2: "xla", 3: "host"}
+# Bucket render order for the codec row (plan keys are
+# "kernel/bucket"; unknown buckets append at the end).
+_BUCKET_ORDER = ("<64K", "64K-1M", "1-4M", "4-16M", "16M+")
 
 
 def fetch_timeline(base_url: str, cluster: bool = False,
@@ -104,6 +110,33 @@ def render(doc: dict, width: int = 60) -> str:
             parts.append(f"{b} {st}"
                          + (f" {rate:.3f} GiB/s" if rate else ""))
     lines.append("kernel: " + (" | ".join(parts) or "no dispatches"))
+
+    # Codec dispatch plan (ops/autotune.py): measured lane per
+    # (kernel, batch-size bucket) — "static" until the probe ladder
+    # has populated the plan.
+    plan = last.get("codecPlan") or {}
+    if plan:
+        by_kernel: dict[str, dict[str, int]] = {}
+        for key, lane in sorted(plan.items()):
+            kernel, _, bucket = key.partition("/")
+            by_kernel.setdefault(kernel, {})[bucket] = lane
+
+        def order(b: str) -> int:
+            return (_BUCKET_ORDER.index(b) if b in _BUCKET_ORDER
+                    else len(_BUCKET_ORDER))
+
+        kparts = []
+        for kernel, buckets in sorted(by_kernel.items()):
+            short = "enc" if kernel == "rs_encode" else (
+                "dec" if kernel == "rs_decode" else kernel)
+            cells = " ".join(
+                f"{b}:{_LANE_ABBREV.get(v, str(v))}"
+                for b, v in sorted(buckets.items(),
+                                   key=lambda kv: order(kv[0])))
+            kparts.append(f"{short}[{cells}]")
+        lines.append("codec: " + "  ".join(kparts))
+    else:
+        lines.append("codec: static policy (autotuner not probed)")
 
     lines.append(f"{'class':<7}{'qps':>8}{'inflight':>10}{'shed/s':>8}")
     for c in _CLASSES:
