@@ -1449,6 +1449,258 @@ def bench_crash_recovery(np, workdir: str) -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_select_scan(np, workdir: str) -> dict:
+    """Columnar S3 Select scan engine vs the row-engine oracle.
+
+    Two paired fixtures (numeric-heavy 256MiB Parquet, string-heavy
+    256MiB CSV), scan GiB/s both ways with BYTE-IDENTICAL payload
+    verification at the paired point, a selectivity sweep
+    (0.1%/10%/90% pass rates) on the columnar side, and a brownout
+    phase: a capped `select` class flooded with scans must shed 503
+    while paired fg PUT/GET p99 stays within noise of the no-scan
+    baseline.  backend_mix is stamped by the config harness like
+    every other config, so a host-mode run can't masquerade as a
+    device number."""
+    from minio_tpu.s3select import parquet as pqm
+    from minio_tpu.s3select.message import decode_messages
+    from minio_tpu.s3select.select import parse_request, run_select
+
+    def _req(expr: str, inp: str) -> dict:
+        from xml.sax.saxutils import escape
+        xml = ("<SelectObjectContentRequest><Expression>"
+               f"{escape(expr)}</Expression>"
+               "<ExpressionType>SQL</ExpressionType>"
+               f"<InputSerialization>{inp}</InputSerialization>"
+               "<OutputSerialization><JSON/></OutputSerialization>"
+               "</SelectObjectContentRequest>")
+        return parse_request(xml.encode())
+
+    def timed_select(req: dict, data: bytes, engine: str):
+        os.environ["MINIO_SELECT_ENGINE"] = engine
+        try:
+            t0 = time.perf_counter()
+            body = run_select(req, data)
+            wall = time.perf_counter() - t0
+        finally:
+            os.environ.pop("MINIO_SELECT_ENGINE", None)
+        msgs = decode_messages(body)
+        if msgs and msgs[0]["headers"].get(":message-type") == "error":
+            raise RuntimeError(f"select errored: {msgs[0]['headers']}")
+        payload = b"".join(
+            m["payload"] for m in msgs
+            if m["headers"].get(":event-type") == "Records")
+        return wall, payload
+
+    out: dict = {"metric": "select_scan",
+                 "unit": "columnar_over_row_speedup"}
+
+    # -- numeric-heavy 256MiB Parquet (the acceptance config) ----------
+    n = 8_388_608  # 4 x float64 columns = 256 MiB of data
+    rng = np.random.default_rng(14)
+    cols = [pqm.Column(c, pqm.DOUBLE, optional=False)
+            for c in ("c0", "c1", "c2", "c3")]
+    pdata = pqm.write_parquet_columns(
+        cols, {c.name: rng.uniform(0.0, 1.0, n) for c in cols}, n)
+    pq_gib = len(pdata) / (1 << 30)
+    sweep = []
+    row_wall = row_payload = None
+    col_wall_paired = None
+    for sel in (0.001, 0.1, 0.9):
+        req = _req(f"SELECT c1 FROM S3Object WHERE c0 < {sel}",
+                   "<Parquet/>")
+        wall, payload = timed_select(req, pdata, "")
+        if sel == 0.1:
+            # Paired point: the row oracle runs the SAME query on the
+            # SAME bytes immediately after, and the payloads must be
+            # byte-identical (the differential suite, at full scale).
+            # Row wall time is selectivity-independent (decode
+            # dominates), so one row run prices all three points.
+            col_wall_paired = wall
+            row_wall, row_payload = timed_select(req, pdata, "row")
+            if row_payload != payload:
+                raise RuntimeError(
+                    "columnar payload diverged from the row oracle "
+                    f"({len(payload)} vs {len(row_payload)} bytes)")
+        sweep.append({
+            "selectivity": sel,
+            "columnar_s": round(wall, 3),
+            "columnar_gibs": round(pq_gib / wall, 3),
+        })
+    pq_speedup = row_wall / col_wall_paired
+    out["value"] = round(pq_speedup, 2)
+    out["parquet"] = {
+        "bytes": len(pdata), "rows": n,
+        "row_s": round(row_wall, 3),
+        "row_gibs": round(pq_gib / row_wall, 4),
+        "columnar_gibs": round(pq_gib / col_wall_paired, 3),
+        "speedup": round(pq_speedup, 2),
+        "selectivity_sweep": sweep,
+    }
+    if pq_speedup < 5.0:
+        raise RuntimeError(
+            f"select_scan speedup {pq_speedup:.2f}x < 5x on the "
+            "numeric-heavy 256MiB Parquet config")
+
+    # -- string-heavy CSV ----------------------------------------------
+    # 96MiB, not 256: the ROW oracle needs ~4 min for 256MiB of CSV
+    # (the whole reason this engine exists) and the paired run prices
+    # both sides; the acceptance-gated 256MiB config is the Parquet
+    # one above.
+    words = np.asarray(["alphaville", "betatronic", "gammaray",
+                        "deltaforce", "epsilonic", "zetapotential",
+                        "etacarinae", "thetawaves"])
+    rows_csv = 2_100_000   # ~96 MiB of ~48-byte lines
+    w1 = words[rng.integers(0, len(words), rows_csv)]
+    w2 = words[rng.integers(0, len(words), rows_csv)]
+    nums = rng.integers(0, 100000, rows_csv).astype("U6")
+    lines = np.char.add(np.char.add(np.char.add(np.char.add(
+        w1, ","), nums), ","), w2)
+    cdata = ("h1,h2,h3\n" + "\n".join(lines.tolist()) + "\n").encode()
+    del lines, w1, w2, nums
+    csv_gib = len(cdata) / (1 << 30)
+    creq = _req("SELECT h2 FROM S3Object WHERE h1 LIKE 'gamma%' "
+                "AND h2 > 90000",
+                "<CSV><FileHeaderInfo>USE</FileHeaderInfo></CSV>")
+    c_wall, c_payload = timed_select(creq, cdata, "")
+    r_wall, r_payload = timed_select(creq, cdata, "row")
+    if r_payload != c_payload:
+        raise RuntimeError("CSV columnar payload diverged from the "
+                           "row oracle")
+    out["csv"] = {
+        "bytes": len(cdata), "rows": rows_csv,
+        "row_s": round(r_wall, 3),
+        "row_gibs": round(csv_gib / r_wall, 4),
+        "columnar_s": round(c_wall, 3),
+        "columnar_gibs": round(csv_gib / c_wall, 3),
+        "speedup": round(r_wall / c_wall, 2),
+    }
+    del cdata
+
+    # -- brownout: capped select class vs fg PUT/GET -------------------
+    from minio_tpu.erasure.engine import ErasureObjects
+    from minio_tpu.obs.metrics2 import METRICS2
+    from minio_tpu.s3.client import S3Client
+    from minio_tpu.s3.server import S3Server
+    from minio_tpu.storage.xl import XLStorage
+    root = os.path.join(workdir, "cfgsel")
+    disks = [XLStorage(os.path.join(root, f"disk{i}"))
+             for i in range(6)]
+    layer = ErasureObjects(disks, 4, 2, block_size=1024 * 1024)
+    srv = S3Server(layer, "benchadmin", "benchadmin-secret")
+    port = srv.start()
+    try:
+        # The server boot kicks the background probe ladder (RS rungs,
+        # jit compiles, select probes); on a 2-core box it would crush
+        # the paired p99 measurement below — drain it first.
+        from minio_tpu.ops.autotune import AUTOTUNE as _AT
+        _AT.ensure_probed(background=False)
+        client = S3Client("127.0.0.1", port, "benchadmin",
+                          "benchadmin-secret")
+        client.make_bucket("selbench")
+        # a 2MiB slice of the parquet fixture as the scan target
+        small_n = 65_536
+        sdata = pqm.write_parquet_columns(
+            cols, {c.name: rng.uniform(0.0, 1.0, small_n)
+                   for c in cols}, small_n)
+        client.put_object("selbench", "t.parquet", sdata)
+        body = rng.integers(0, 256, 1024 * 1024).astype(
+            np.uint8).tobytes()
+        for i in range(4):
+            client.put_object("selbench", f"warm-{i}", body)
+        sel_xml = (
+            "<SelectObjectContentRequest><Expression>"
+            "SELECT c1 FROM S3Object WHERE c0 &lt; 0.5"
+            "</Expression><ExpressionType>SQL</ExpressionType>"
+            "<InputSerialization><Parquet/></InputSerialization>"
+            "<OutputSerialization><JSON/></OutputSerialization>"
+            "</SelectObjectContentRequest>").encode()
+
+        def fg_lat(tag: str, ops: int = 40):
+            put, get = [], []
+            for i in range(ops):
+                t0 = time.perf_counter()
+                r = client.put_object("selbench", f"{tag}-{i}", body)
+                put.append(time.perf_counter() - t0)
+                if r.status != 200:
+                    raise RuntimeError(f"PUT {r.status}")
+                t0 = time.perf_counter()
+                r = client.get_object("selbench", f"{tag}-{i}")
+                get.append(time.perf_counter() - t0)
+                if r.status != 200:
+                    raise RuntimeError(f"GET {r.status}")
+            return put, get
+
+        def p99(xs):
+            return sorted(xs)[max(0, int(len(xs) * 0.99) - 1)] * 1e3
+
+        put_off1, get_off1 = fg_lat("off1")
+        srv.config.set_kv("api requests_max_select=1 "
+                          "requests_deadline=250ms")
+        stop = threading.Event()
+        shed = [0]
+        okc = [0]
+
+        def scan_forever():
+            sc = S3Client("127.0.0.1", port, "benchadmin",
+                          "benchadmin-secret")
+            while not stop.is_set():
+                r = sc.request("POST", "/selbench/t.parquet",
+                               query="select=&select-type=2",
+                               body=sel_xml)
+                if r.status == 503:
+                    shed[0] += 1
+                elif r.status == 200:
+                    okc[0] += 1
+
+        threads = [threading.Thread(target=scan_forever, daemon=True)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)  # flood reaches the cap
+        put_on, get_on = fg_lat("on")
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        srv.config.set_kv("api requests_max_select=0 "
+                          "requests_deadline=10s")
+        put_off2, get_off2 = fg_lat("off2")
+        put_off = put_off1 + put_off2
+        get_off = get_off1 + get_off2
+        if shed[0] < 1:
+            raise RuntimeError(
+                "capped select class never shed under the scan flood "
+                f"(ok={okc[0]})")
+        put_ratio = p99(put_on) / max(p99(put_off), 1e-9)
+        get_ratio = p99(get_on) / max(p99(get_off), 1e-9)
+        out["brownout"] = {
+            "select_cap": 1, "scan_threads": 4,
+            "select_ok": okc[0], "select_shed_503": shed[0],
+            "fg_put_p99_off_ms": round(p99(put_off), 2),
+            "fg_put_p99_on_ms": round(p99(put_on), 2),
+            "fg_put_p99_ratio": round(put_ratio, 3),
+            "fg_get_p99_off_ms": round(p99(get_off), 2),
+            "fg_get_p99_on_ms": round(p99(get_on), 2),
+            "fg_get_p99_ratio": round(get_ratio, 3),
+            "select_sheds_total": METRICS2.get(
+                "minio_tpu_v2_qos_shed_total",
+                {"class": "select", "reason": "wait-deadline"}),
+        }
+        # Two python processes' worth of work on 2 cores: allow real
+        # scheduling noise, catch real starvation.
+        if put_ratio > 3.0 or get_ratio > 3.0:
+            raise RuntimeError(
+                "fg p99 degraded past noise under the capped scan "
+                f"flood (put x{put_ratio:.2f}, get x{get_ratio:.2f})")
+        out["fg_p99_ratio"] = round(max(put_ratio, get_ratio), 3)
+    finally:
+        srv.stop()
+        shutil.rmtree(root, ignore_errors=True)
+
+    from minio_tpu.ops.autotune import AUTOTUNE
+    out["select_plan"] = AUTOTUNE.plan_compact().get("select_scan", {})
+    return out
+
+
 class _DeviceHunt(threading.Thread):
     """Background device acquisition for the WHOLE bench run.
 
@@ -1597,7 +1849,9 @@ def main() -> None:
                      ("front_door",
                       lambda: bench_front_door(np, workdir)),
                      ("crash_recovery",
-                      lambda: bench_crash_recovery(np, workdir))):
+                      lambda: bench_crash_recovery(np, workdir)),
+                     ("select_scan",
+                      lambda: bench_select_scan(np, workdir))):
         _progress(f"config {name} (host mode)")
         pipe = config_pipeline.get(name)
         factor_box: dict = {}

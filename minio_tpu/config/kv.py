@@ -37,6 +37,11 @@ DEFAULT_KVS: dict[str, dict[str, str]] = {
         "requests_max_write": "0",
         "requests_max_list": "0",
         "requests_max_admin": "0",
+        # SelectObjectContent runs as its OWN admission class: a
+        # capped analytics sweep sheds 503 SlowDown instead of
+        # competing with PUT/GET for slots (scan kernel dispatches
+        # additionally ride the background QoS lane).
+        "requests_max_select": "0",
         "cors_allow_origin": "*",
     },
     "compression": {
@@ -158,6 +163,7 @@ DEFAULT_KVS: dict[str, dict[str, str]] = {
         "slow_ms_write": "",
         "slow_ms_list": "",
         "slow_ms_admin": "",
+        "slow_ms_select": "",
         "profile_on_slow": "off",
         # Timeline sample ring (obs/timeline.py): one sample every
         # `timeline_sample`, kept for `timeline_retention` at fixed

@@ -24,6 +24,10 @@ from .metrics2 import METRICS2
 RS_ENCODE = "rs_encode"
 RS_DECODE = "rs_decode"
 HH256 = "hh256"
+# Columnar S3 Select predicate scan (ops/select_kernels.py): the
+# analytics workload's kernel identity in the dispatch profiles, the
+# autotuner model and the backend health machine.
+SELECT_SCAN = "select_scan"
 
 
 class KernelStats:

@@ -487,3 +487,24 @@ METRICS2.register(
     "minio_tpu_v2_conn_parse_errors_total", "counter",
     "Connections rejected at the HTTP framing layer (malformed head, "
     "oversized head, bad Content-Length, failed TLS handshake).")
+METRICS2.register(
+    "minio_tpu_v2_select_scanned_bytes_total", "counter",
+    "Object bytes read by SelectObjectContent scans "
+    "(the BytesScanned the Progress/Stats events report).")
+METRICS2.register(
+    "minio_tpu_v2_select_processed_bytes_total", "counter",
+    "Bytes the select scan actually decoded (columnar Parquet scans "
+    "prune to the referenced columns' uncompressed pages) — the "
+    "BytesProcessed numerator and the timeline's scan GiB/s source.")
+METRICS2.register(
+    "minio_tpu_v2_select_returned_bytes_total", "counter",
+    "Payload bytes returned in select Records events.")
+METRICS2.register(
+    "minio_tpu_v2_select_requests_total", "counter",
+    "SelectObjectContent queries executed, by engine "
+    "(columnar/row/error).")
+METRICS2.register(
+    "minio_tpu_v2_select_fallback_rows_total", "counter",
+    "Rows the columnar scan routed through the row-engine fallback "
+    "(division by zero, exact-integer overflow, complex LIKE, "
+    "row-tier batches) — exactness escapes, not errors.")

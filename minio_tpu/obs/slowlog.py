@@ -33,15 +33,16 @@ from collections import deque
 
 BLAME_ADMISSION = "admission-wait"
 BLAME_ENCODE = "encode-kernel"
+BLAME_SCAN = "scan-kernel"
 BLAME_DISK = "disk"
 BLAME_RPC = "rpc"
 BLAME_CLIENT = "client-stream"
 BLAME_OTHER = "other"
 
-BLAME_LAYERS = (BLAME_ADMISSION, BLAME_ENCODE, BLAME_DISK, BLAME_RPC,
-                BLAME_CLIENT, BLAME_OTHER)
+BLAME_LAYERS = (BLAME_ADMISSION, BLAME_ENCODE, BLAME_SCAN, BLAME_DISK,
+                BLAME_RPC, BLAME_CLIENT, BLAME_OTHER)
 
-API_CLASSES = ("read", "write", "list", "admin")
+API_CLASSES = ("read", "write", "list", "admin", "select")
 
 
 def _bucket_for(name: str) -> str | None:
@@ -50,6 +51,12 @@ def _bucket_for(name: str) -> str | None:
         return BLAME_DISK
     if name.startswith("rpc."):
         return BLAME_RPC
+    if name.startswith("select."):
+        # Columnar S3 Select scan work (s3select/engine.py): a
+        # scan-bound SelectObjectContent blames its kernel time, not
+        # client-stream — the disk/decode spans BELOW select.scan
+        # still re-bucket to their own layers.
+        return BLAME_SCAN
     if (name.startswith("kernel.") or name == "ec.encode"
             or name.startswith("bitrot")):
         return BLAME_ENCODE

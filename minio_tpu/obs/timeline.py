@@ -43,7 +43,7 @@ MAX_SAMPLES = 36000
 DEFAULT_PERIOD_S = 1.0
 DEFAULT_RETENTION_S = 15 * 60.0
 
-_CLASSES = ("read", "write", "list", "admin")
+_CLASSES = ("read", "write", "list", "admin", "select")
 
 
 def _series_sum(metric: dict, by: str | None = None,
@@ -239,6 +239,12 @@ class Timeline:
                 m("minio_tpu_v2_accept_queue_depth")),
             "parseErrors": _series_sum(
                 m("minio_tpu_v2_conn_parse_errors_total")),
+            # Analytics scan volume (s3select): decoded bytes +
+            # queries, delta'd into a select GiB/s row in mtpu_top.
+            "selectProcessed": _series_sum(
+                m("minio_tpu_v2_select_processed_bytes_total")),
+            "selectRequests": _series_sum(
+                m("minio_tpu_v2_select_requests_total")),
             "mrfDepth": _series_sum(m("minio_tpu_v2_mrf_queue_depth")),
             # Durable-queue twin of mrfDepth: live entries in the
             # per-set MRF journal (watchdog recovery_backlog watches
@@ -326,6 +332,10 @@ class Timeline:
                 "acceptQueue": raw.get("acceptQueue", 0),
                 "parseErrors": _d(raw.get("parseErrors", 0),
                                   prev.get("parseErrors", 0)),
+                "selectProcessed": _d(raw.get("selectProcessed", 0),
+                                      prev.get("selectProcessed", 0)),
+                "selectRequests": _d(raw.get("selectRequests", 0),
+                                     prev.get("selectRequests", 0)),
                 "mrfDepth": raw["mrfDepth"],
                 "mrfJournal": raw.get("mrfJournal", 0),
                 "drives": dict(raw["drives"]),
@@ -431,6 +441,7 @@ def _collapse_node(snap: dict, period_s: float) -> list[dict]:
             "inflight": dict(last.get("inflight") or {}),
             "queueDepth": last.get("queueDepth", 0),
             "rx": 0, "tx": 0, "hedgeFired": 0, "resets": 0,
+            "selectProcessed": 0, "selectRequests": 0,
             "cacheHits": 0, "cacheMisses": 0, "cacheFills": 0,
             "cacheBytes": last.get("cacheBytes", 0),
             "conns": last.get("conns", 0),
@@ -452,7 +463,8 @@ def _collapse_node(snap: dict, period_s: float) -> list[dict]:
                     c[fld][k] = c[fld].get(k, 0) + v
             for fld in ("rx", "tx", "hedgeFired", "cacheHits",
                         "cacheMisses", "cacheFills", "resets",
-                        "parseErrors"):
+                        "parseErrors", "selectProcessed",
+                        "selectRequests"):
                 c[fld] += s.get(fld, 0)
             for k, v in (s.get("backendState") or {}).items():
                 c["backendState"][k] = max(c["backendState"].get(k, 0),
@@ -500,6 +512,7 @@ def merge_timelines(snapshots: list[dict],
                     "hedgeFired": 0, "mrfDepth": 0, "mrfJournal": 0,
                     "conns": 0, "acceptQueue": 0, "parseErrors": 0,
                     "resets": 0,
+                    "selectProcessed": 0, "selectRequests": 0,
                     "cacheHits": 0, "cacheMisses": 0,
                     "cacheFills": 0, "cacheBytes": 0,
                     "drives": {"suspect": 0, "faulty": 0,
@@ -518,7 +531,8 @@ def merge_timelines(snapshots: list[dict],
                         "mrfDepth", "mrfJournal", "cacheHits",
                         "cacheMisses", "cacheFills", "cacheBytes",
                         "conns", "acceptQueue", "parseErrors",
-                        "resets"):
+                        "resets", "selectProcessed",
+                        "selectRequests"):
                 cur[fld] += s.get(fld, 0)
             for k, v in (s.get("drives") or {}).items():
                 cur["drives"][k] = cur["drives"].get(k, 0) + v

@@ -57,7 +57,7 @@ from collections import deque
 OK, PENDING, FIRING = "ok", "pending", "firing"
 _STATE_RANK = {OK: 0, PENDING: 1, FIRING: 2}
 
-_CLASSES = ("read", "write", "list", "admin")
+_CLASSES = ("read", "write", "list", "admin", "select")
 
 # (rule name, per-class sample field, human label) for the three
 # burn-rate signals. The fields are the timeline's per-sample DELTAS,

@@ -60,7 +60,16 @@ from ..obs.kernprof import BACKENDS, DEVICE, HOST, NATIVE, XLA_CPU
 
 RS_ENCODE = "rs_encode"
 RS_DECODE = "rs_decode"
-KERNELS = (RS_ENCODE, RS_DECODE)
+SELECT_SCAN = "select_scan"
+KERNELS = (RS_ENCODE, RS_DECODE, SELECT_SCAN)
+# The RS probe ladder seeds only the codec kernels — select scans get
+# their OWN known-answer probe (ops/select_kernels.probe_lane): GF
+# table-gather throughput says nothing about predicate-mask math.
+_CODEC_KERNELS = (RS_ENCODE, RS_DECODE)
+# Lanes a select scan can actually run on: there is no C++ select
+# kernel, so NATIVE is not probed (decide() falling back to NATIVE is
+# mapped to HOST by select_kernels.choose_lane).
+_SELECT_PROBE_ROWS = 4096
 
 # Batch-size buckets for the dispatch decision: coalesced-dispatch
 # bytes, not block counts (the decision input is "how big is this
@@ -149,6 +158,7 @@ class CodecAutotuner:
         self._probe_mu = threading.Lock()
         self._probe_thread: threading.Thread | None = None
         self._last_probe: dict[str, dict] = {}
+        self._last_select_probe: dict[str, dict] = {}
         # Transition fan-out, kernprof-style: decided under _mu,
         # published FIFO under _announce_mu so two threads replanning
         # back-to-back can't publish the sinks in swapped order.
@@ -351,9 +361,10 @@ class CodecAutotuner:
             top = results[lane].get("4-16M")
             if top:
                 with self._mu:
-                    for kern in KERNELS:
+                    for kern in _CODEC_KERNELS:
                         self._feed_locked(kern, TOP_BUCKET, lane,
                                           top * (1 << 30))
+        self._probe_select_lanes()
         with self._mu:
             self._last_probe = results
             for kern in KERNELS:
@@ -372,7 +383,7 @@ class CodecAutotuner:
                       "result": "pass" if bps else "fail"})
         if bps:
             with self._mu:
-                for kern in KERNELS:
+                for kern in _CODEC_KERNELS:
                     # One ladder seeds both codec kernels: encode and
                     # reconstruct run the same GF apply machinery, and
                     # live refinement keys them apart from here on.
@@ -385,6 +396,49 @@ class CodecAutotuner:
             Logger.get().info(
                 f"autotune: probe {lane}[{bucket}] failed ({err})",
                 "autotune", lane=lane, bucket=bucket)
+
+    def _probe_select_lanes(self) -> None:
+        """Known-answer select-scan probes per size rung: the jit lane
+        (device when one answers, xla-cpu otherwise) and the numpy
+        host lane — seeding the (select_scan, bucket, lane) model so
+        scan dispatch probes-and-picks like RS math does."""
+        from .select_kernels import probe_lane
+        jit_lane = DEVICE if self._device_visible() else XLA_CPU
+        results: dict[str, dict] = {}
+        for lane in (jit_lane, HOST):
+            results[lane] = {}
+            for bucket, _B, _S in _PROBE_RUNGS:
+                nbytes = _B * _PROBE_K * _S
+                # two float32 columns per probe batch
+                rows = max(_SELECT_PROBE_ROWS, nbytes // 8)
+                bps, err = probe_lane(lane, rows)
+                from ..obs.metrics2 import METRICS2
+                from ..logger import Logger
+                METRICS2.inc("minio_tpu_v2_codec_plan_probes_total",
+                             {"lane": lane,
+                              "result": "pass" if bps else "fail"})
+                if bps:
+                    with self._mu:
+                        self._feed_locked(SELECT_SCAN, bucket, lane,
+                                          bps)
+                    Logger.get().info(
+                        f"autotune: probe select/{lane}[{bucket}] "
+                        f"{bps / (1 << 30):.3f} GiB/s", "autotune",
+                        lane=lane, bucket=bucket)
+                else:
+                    Logger.get().info(
+                        f"autotune: probe select/{lane}[{bucket}] "
+                        f"failed ({err})", "autotune", lane=lane,
+                        bucket=bucket)
+                results[lane][bucket] = (
+                    round(bps / (1 << 30), 6) if bps else None)
+            top = results[lane].get("4-16M")
+            if top:
+                with self._mu:
+                    self._feed_locked(SELECT_SCAN, TOP_BUCKET, lane,
+                                      top * (1 << 30))
+        with self._mu:
+            self._last_select_probe = results
 
     @staticmethod
     def _device_visible() -> bool:
@@ -626,6 +680,7 @@ class CodecAutotuner:
                 "plan": plan,
                 "crossover": crossover,
                 "lastProbe": self._last_probe,
+                "lastSelectProbe": self._last_select_probe,
             }
         out["backendStates"] = {
             b: KERNPROF.state_of(b) for b in BACKENDS}
@@ -638,6 +693,7 @@ class CodecAutotuner:
             self._plan_version = 0
             self._probed = False
             self._last_probe = {}
+            self._last_select_probe = {}
             self._pending.clear()
         self.enabled = True
         self.hysteresis = self.HYSTERESIS

@@ -1,0 +1,233 @@
+"""Batched predicate kernels for columnar S3 Select.
+
+The scan engine (s3select/engine.py) hands a compiled predicate plan
+plus one ColumnBatch here per dispatch; this module owns WHERE the
+math runs and the accounting that keeps that decision honest:
+
+- **Lane choice** rides the measured autotuner model
+  (ops/autotune.py, kernel ``select_scan``) like RS math does: the
+  fastest healthy lane per batch-size bucket wins, a kernprof-DOWN
+  lane is never chosen, and every dispatch feeds the model back
+  through ``KernelStats.record``.  There is no C++ select kernel, so
+  a NATIVE plan resolves to the numpy host lane.
+
+- **The jit lanes** (device when an accelerator answers, xla-cpu
+  otherwise) evaluate the SAME compile.py node tree under jax.numpy,
+  traced once per plan and cached.  Only float32-exact plans are
+  eligible (compile.Plan.jit_ok + the dtype check at bind) — the jit
+  image must be bit-exact against the row oracle, not approximately
+  right.  int32 cells past 2^24 join the fallback mask at bind for
+  the same reason.
+
+- **QoS**: every dispatch enters the priority gate on the BACKGROUND
+  lane — an analytics sweep's kernels defer to in-flight PUT/GET
+  dispatches and promote only by aging, so heavy scans cannot starve
+  the serving path (the `select` admission class caps concurrency
+  one layer up).
+
+- A jit-lane failure feeds the kernprof backend state machine
+  (``batching.device_dispatch_failed``) and the batch re-runs on the
+  host lane — scans degrade exactly like RS dispatch does.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..obs.kernel_stats import KERNEL, SELECT_SCAN, timed
+from ..obs.kernprof import DEVICE, HOST, NATIVE, XLA_CPU
+
+# int32 cells past float32's exact-integer range (2^24) cannot ride
+# the f32 jit image exactly; they take the row fallback instead.
+_F32_INT_EXACT = 1 << 24
+
+_jit_build_mu = threading.Lock()
+
+
+def plan_nbytes(plan, batch) -> int:
+    """Referenced-column payload bytes: the autotuner's size-bucket
+    input for this dispatch."""
+    total = 0
+    for name in plan.cols:
+        col = batch.col(name)
+        if col is not None:
+            total += col.data_nbytes()
+    return total
+
+
+def choose_lane(plan, nbytes: int) -> str:
+    """The measured plan's lane for this dispatch; NATIVE resolves to
+    HOST (no C++ select kernel), jit lanes require a jit-eligible
+    plan."""
+    from .autotune import AUTOTUNE
+    lane = AUTOTUNE.decide(SELECT_SCAN, nbytes)
+    if lane == NATIVE:
+        lane = HOST
+    if lane in (DEVICE, XLA_CPU) and not plan.jit_ok:
+        lane = HOST
+    return lane
+
+
+def eval_predicate(plan, batch) -> tuple[np.ndarray, np.ndarray]:
+    """Evaluate a compiled predicate over one batch ->
+    (pass mask, fallback mask); accounts the dispatch under kernel
+    ``select_scan`` with the lane that actually ran."""
+    from ..qos import scheduler as qos_sched
+    from ..s3select.compile import passing_mask
+    n = batch.nrows
+    nbytes = plan_nbytes(plan, batch)
+    lane = choose_lane(plan, nbytes)
+    blocks = max(1, len(plan.cols))
+    with qos_sched.GATE.dispatch(qos_sched.BACKGROUND):
+        if lane in (DEVICE, XLA_CPU):
+            bound = _bind_jit(plan, batch)
+            if bound is None:
+                lane = HOST
+            else:
+                arrs, base_fb = bound
+                try:
+                    with timed() as t:
+                        val, valid = _run_jit(plan, arrs, n)
+                    ok = (np.asarray(val) & np.asarray(valid)
+                          & ~base_fb)
+                    KERNEL.record(SELECT_SCAN, True, nbytes, t.s,
+                                  blocks=blocks, backend=lane)
+                    return ok, base_fb
+                except Exception as exc:  # noqa: BLE001 - lane failover
+                    from .batching import device_dispatch_failed
+                    device_dispatch_failed(exc)
+                    lane = HOST
+        with timed() as t:
+            vv = plan.eval_host(batch)
+            ok, fb = passing_mask(vv, n)
+        KERNEL.record(SELECT_SCAN, False, nbytes, t.s, blocks=blocks,
+                      backend=HOST)
+        return ok, fb
+
+
+# -- jit lane ----------------------------------------------------------------
+
+
+def _bind_jit(plan, batch):
+    """(ordered arrays, base fallback mask) for the f32 jit image, or
+    None when a referenced column's dtype has no exact f32 embedding
+    (int64/float64/strings) — the host lane then runs the batch."""
+    n = batch.nrows
+    arrs: list[np.ndarray] = []
+    fb = np.zeros(n, dtype=bool)
+    for name in plan.cols:
+        col = batch.col(name)
+        if col is None:
+            arrs.extend((np.zeros(n, dtype=np.float32),
+                         np.zeros(n, dtype=bool),
+                         np.ones(n, dtype=bool)))
+            continue
+        valid = ~col.null_mask()
+        miss = col.miss_mask()
+        if col.kind == "bool":
+            arrs.extend((np.asarray(col.raw, dtype=bool), valid,
+                         miss))
+            continue
+        if col.kind != "num":
+            return None
+        raw = np.asarray(col.raw)
+        if raw.dtype.kind == "f":
+            if raw.dtype.itemsize > 4:
+                return None
+            vals = raw.astype(np.float32)
+        elif raw.dtype.kind in "iu":
+            if raw.dtype.itemsize > 4:
+                return None
+            big = np.abs(raw.astype(np.int64)) > _F32_INT_EXACT
+            if big.any():
+                fb |= big & valid
+            vals = raw.astype(np.float32)
+        else:
+            return None
+        arrs.extend((vals, valid, miss))
+    return arrs, fb
+
+
+def _run_jit(plan, arrs: list[np.ndarray], n: int):
+    fn = plan._jit_fn
+    if fn is None:
+        with _jit_build_mu:
+            fn = plan._jit_fn
+            if fn is None:
+                fn = plan._jit_fn = _build_jit(plan)
+    return fn(*arrs)
+
+
+def _build_jit(plan):
+    import jax
+
+    from ..s3select.compile import Ctx
+
+    order = list(plan.cols)
+
+    def fn(*arrs):
+        import jax.numpy as jnp
+        n = arrs[0].shape[0]
+        arrays = {name: (arrs[3 * i], arrs[3 * i + 1],
+                         arrs[3 * i + 2])
+                  for i, name in enumerate(order)}
+        vv = plan.root.run(Ctx(jnp, n, arrays=arrays))
+        val = jnp.broadcast_to(jnp.asarray(vv.val), (n,))
+        valid = jnp.broadcast_to(jnp.asarray(vv.valid), (n,))
+        return val, valid
+
+    return jax.jit(fn)
+
+
+# -- autotune probe ----------------------------------------------------------
+
+
+def probe_lane(lane: str, nrows: int) -> tuple[float | None, str]:
+    """One sized known-answer probe of a select lane: (bytes/s, "")
+    or (None, cause).  A REAL dispatch — it routes through the
+    fault-injection `kernel` hook like the RS probes, so an active
+    fault plan keeps the lane unmeasured."""
+    import time as _time
+
+    from ..faultinject import FAULTS
+    from ..s3select import sql
+    from ..s3select.columnar import Column, ColumnBatch
+    from ..s3select.compile import Plan, lower, passing_mask
+
+    rng = np.random.default_rng(nrows)
+    a = rng.integers(0, 97, nrows).astype(np.float32)
+    b = rng.integers(0, 97, nrows).astype(np.float32)
+    cols = {"a": Column("a", "num", raw=a),
+            "b": Column("b", "num", raw=b)}
+    batch = ColumnBatch(["a", "b"], cols, nrows, int(a.nbytes * 2))
+    where = sql.BoolOp("and", sql.Cmp("<", sql.Col(("a",)),
+                                      sql.Lit(48)),
+                       sql.Cmp(">=", sql.Col(("b",)), sql.Lit(16)))
+    plan = Plan(lower(where, batch))
+    want = (a < 48) & (b >= 16)
+    try:
+        FAULTS.kernel(SELECT_SCAN)
+        if lane in (DEVICE, XLA_CPU):
+            bound = _bind_jit(plan, batch)
+            if bound is None:
+                return None, "jit bind declined"
+            arrs, _ = bound
+
+            def run():
+                val, valid = _run_jit(plan, arrs, nrows)
+                return np.asarray(val) & np.asarray(valid)
+        else:
+            def run():
+                return passing_mask(plan.eval_host(batch),
+                                    nrows)[0]
+        got = run()   # warm: trace/compile
+        t0 = _time.perf_counter()
+        got = run()
+        wall = _time.perf_counter() - t0
+        if not (np.asarray(got) == want).all():
+            return None, "known-answer mismatch"
+        return batch.nbytes / max(wall, 1e-9), ""
+    except Exception as exc:  # noqa: BLE001 - a probe must not raise
+        return None, f"{type(exc).__name__}: {exc}"

@@ -27,7 +27,7 @@ import time
 
 from .deadline import Deadline
 
-API_CLASSES = ("read", "write", "list", "admin")
+API_CLASSES = ("read", "write", "list", "admin", "select")
 
 # Bounded wait queue: at most this many waiters per enforced cap slot.
 QUEUE_FACTOR = 4
@@ -47,9 +47,16 @@ class AdmissionShed(Exception):
         self.retry_after = retry_after
 
 
-def classify(method: str, bucket: str, key: str) -> str:
+def classify(method: str, bucket: str, key: str,
+             params=()) -> str:
     """Map a request shape to its admission class (the coarse read /
-    write / list / admin split the caps are keyed by)."""
+    write / list / admin / select split the caps are keyed by).
+    SelectObjectContent gets its OWN class: an analytics sweep is
+    CPU/kernel-bound scan work, and a dedicated cap
+    (`api.requests_max_select`) lets an operator brown it out without
+    touching PUT/GET capacity."""
+    if key and method == "POST" and "select" in params:
+        return "select"
     if key:
         return "read" if method in ("GET", "HEAD") else "write"
     if bucket:
@@ -170,7 +177,9 @@ class AdmissionController:
     def foreground_inflight(self) -> int:
         """Client-facing in-flight work (read/write/list) — the
         scheduler's foreground-busy probe; admin traffic is not
-        latency-sensitive foreground load."""
+        latency-sensitive foreground load, and neither are `select`
+        scans — their kernel dispatches run BACKGROUND-lane and must
+        not count themselves as the foreground they defer to."""
         return sum(self._classes[c].inflight
                    for c in ("read", "write", "list"))
 
@@ -212,7 +221,7 @@ class AdmissionController:
     def _release(self, api_class: str) -> None:
         self._classes[api_class].release()
         self._global.release()
-        if api_class != "admin":
+        if api_class in ("read", "write", "list"):
             self._last_fg_release = time.monotonic()
         self._observe(api_class, self._classes[api_class], None)
 

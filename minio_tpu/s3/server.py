@@ -2456,7 +2456,8 @@ class S3Server:
             self.qos.configure(
                 int(cfg.get("api", "requests_max") or "0"),
                 {c: int(cfg.get("api", f"requests_max_{c}") or "0")
-                 for c in ("read", "write", "list", "admin")},
+                 for c in ("read", "write", "list", "admin",
+                           "select")},
                 parse_duration(cfg.get("api", "requests_deadline")))
         except ValueError as e:  # env override may carry garbage
             from ..logger import Logger
@@ -2550,7 +2551,8 @@ class S3Server:
             SLOWLOG.configure(
                 default_ms,
                 {c: _ms(f"slow_ms_{c}")
-                 for c in ("read", "write", "list", "admin")},
+                 for c in ("read", "write", "list", "admin",
+                           "select")},
                 cfg.get("obs", "profile_on_slow") == "on")
         except ValueError as e:  # env override may carry garbage
             from ..logger import Logger
@@ -2897,7 +2899,8 @@ class S3Server:
         budget."""
         from ..qos import admission as adm
         from ..qos import deadline as dl
-        api_class = adm.classify(req.method, req.bucket, req.key)
+        api_class = adm.classify(req.method, req.bucket, req.key,
+                                 req.params)
         req.qos_class = api_class
         budget_s = self.qos.deadline_s if self.qos.engaged else 0.0
         req.qos_deadline_s = budget_s

@@ -98,11 +98,17 @@ def test_probe_ladder_measures_and_plans(ladder_results):
     if all(v for v in res.get(NATIVE, {}).values()):
         assert res[NATIVE]["1-4M"] > res[XLA_CPU]["1-4M"]
     # Full plan coverage, every bucket on a measured healthy lane.
-    assert set(plan) == {(k, b) for k in (RS_ENCODE, RS_DECODE)
+    # Codec kernels fully covered; the select kernel's OWN probe
+    # ladder (ops/select_kernels.probe_lane) covers its buckets too.
+    assert set(plan) == {(k, b)
+                         for k in (RS_ENCODE, RS_DECODE,
+                                   "select_scan")
                          for b in BUCKETS}
     fastest = {b: max((res[ln][b], ln) for ln in res)[1]
                for b in ("<64K", "64K-1M", "1-4M", "4-16M")}
     for (kern, bucket), lane in plan.items():
+        if kern not in (RS_ENCODE, RS_DECODE):
+            continue  # select_scan plans from its OWN probe results
         if bucket in fastest:
             assert lane == fastest[bucket], (kern, bucket)
 

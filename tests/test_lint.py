@@ -738,6 +738,73 @@ def test_o8_autotune_literal_recording_calls():
         _ctx(bad, "minio_tpu/ops/batching.py"))
 
 
+def test_r10_no_row_eval_in_columnar_scan_path():
+    from tools.mtpu_lint.rules.selectscan import SelectScanRowEvalRule
+    # POSITIVE: per-row Node.eval and a sql.execute hand-off inside
+    # the scan path.
+    bad = ("def scan(where, batch):\n"
+           "    for i in range(batch.nrows):\n"
+           "        if where.eval(batch.record(i)) is True:\n"
+           "            pass\n"
+           "    return sql.execute(q, recs)\n")
+    assert len(_check(SelectScanRowEvalRule(), bad,
+                      "minio_tpu/s3select/engine.py")) == 2
+    # NEGATIVE: vectorized node .run() calls and fallback-module
+    # routing are the sanctioned shapes.
+    good = ("def scan(plan, batch, ctx):\n"
+            "    vv = plan.root.run(ctx)\n"
+            "    return fallback.eval_where(where, batch.record(0))\n")
+    assert _check(SelectScanRowEvalRule(), good,
+                  "minio_tpu/s3select/compile.py") == []
+    # The designated fallback module (and the row engine itself) are
+    # out of scope — that is where per-row eval BELONGS.
+    assert not SelectScanRowEvalRule().applies(
+        _ctx(bad, "minio_tpu/s3select/fallback.py"))
+    assert not SelectScanRowEvalRule().applies(
+        _ctx(bad, "minio_tpu/s3select/sql.py"))
+
+
+def test_r10_waiver_escape_hatch():
+    from tools.mtpu_lint.rules.selectscan import SelectScanRowEvalRule
+    src = ("def scan(where, rec):\n"
+           "    return where.eval(rec)  "
+           "# mtpu-lint: disable=R10 -- one-off schema sniff, "
+           "not the row loop\n")
+    ctx = _ctx(src, "minio_tpu/s3select/engine.py")
+    raw = SelectScanRowEvalRule().check(ctx)
+    assert len(raw) == 1  # fires pre-suppression…
+    waived_lines = {s.line for s in ctx.suppressions
+                    if "R10" in s.rules}
+    assert all(f.line in waived_lines for f in raw)  # …and is waived
+
+
+def test_o9_select_literal_recording_calls():
+    from tools.mtpu_lint.rules.obs import SelectMetricCallRule
+    # POSITIVE: dynamic name + unregistered select_* literal.
+    bad = ("def f(name):\n"
+           "    METRICS2.inc(name)\n"
+           "    METRICS2.inc('minio_tpu_v2_select_bogus_total')\n")
+    assert len(_check(SelectMetricCallRule(), bad,
+                      "minio_tpu/s3select/select.py")) == 2
+    # NEGATIVE: the real select_* series are registered.
+    good = ("def f():\n"
+            "    METRICS2.inc("
+            "'minio_tpu_v2_select_scanned_bytes_total', None, 1)\n"
+            "    METRICS2.inc("
+            "'minio_tpu_v2_select_processed_bytes_total', None, 1)\n"
+            "    METRICS2.inc("
+            "'minio_tpu_v2_select_returned_bytes_total', None, 1)\n"
+            "    METRICS2.inc('minio_tpu_v2_select_requests_total',"
+            " {'engine': 'columnar'})\n"
+            "    METRICS2.inc("
+            "'minio_tpu_v2_select_fallback_rows_total', None, 1)\n")
+    assert _check(SelectMetricCallRule(), good,
+                  "minio_tpu/ops/select_kernels.py") == []
+    # Out of scope: the rule does not apply elsewhere in ops/.
+    assert not SelectMetricCallRule().applies(
+        _ctx(bad, "minio_tpu/ops/batching.py"))
+
+
 # ---------------------------------------------------------------------------
 # Framework: suppressions, baseline, output modes
 

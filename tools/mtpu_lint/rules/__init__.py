@@ -12,9 +12,11 @@ from .locks import BlockingUnderLockRule
 from .obs import (AutotuneMetricCallRule, DrivemonSlowlogMetricCallRule,
                   KernprofTimelineMetricCallRule, MetricNameRule,
                   NativeAssertRule, PipelineMetricCallRule,
-                  QosMetricCallRule, WatchdogIncidentMetricCallRule)
+                  QosMetricCallRule, SelectMetricCallRule,
+                  WatchdogIncidentMetricCallRule)
 from .resources import ResourceLeakRule
 from .retries import BoundedRetryRule
+from .selectscan import SelectScanRowEvalRule
 
 
 def all_rules():
@@ -28,6 +30,7 @@ def all_rules():
         CommitReplaceRule(),
         AsyncBlockingRule(),
         DispatchPolicyRule(),
+        SelectScanRowEvalRule(),
         NativeAssertRule(),
         MetricNameRule(),
         QosMetricCallRule(),
@@ -35,4 +38,5 @@ def all_rules():
         DrivemonSlowlogMetricCallRule(),
         KernprofTimelineMetricCallRule(),
         WatchdogIncidentMetricCallRule(),
+        SelectMetricCallRule(),
     ]

@@ -12,6 +12,8 @@ O5  obs/drivemon.py + obs/slowlog.py recording calls likewise
 O6  obs/kernprof.py + obs/timeline.py recording calls likewise
 O7  obs/watchdog.py + obs/incidents.py recording calls likewise
 O8  ops/autotune.py recording calls likewise (codec_plan_* series)
+O9  s3select/ + ops/select_kernels.py recording calls likewise
+    (select_* series)
 """
 
 from __future__ import annotations
@@ -152,3 +154,12 @@ class AutotuneMetricCallRule(_LiteralCallRule):
     title = "autotune metric recordings use literal registered names"
     what = "autotune"
     paths = ("minio_tpu/ops/autotune.py",)
+
+
+class SelectMetricCallRule(_LiteralCallRule):
+    id = "O9"
+    title = ("s3select/select-kernel metric recordings use literal "
+             "registered names")
+    what = "s3select"
+    paths = ("minio_tpu/s3select/",
+             "minio_tpu/ops/select_kernels.py")

@@ -1,0 +1,55 @@
+"""R10 — the columnar scan path stays columnar.
+
+The whole point of the vectorized S3 Select engine is that the hot
+path never walks the AST per record; the row engine survives ONLY as
+the semantics oracle and the designated fallback tier
+(``minio_tpu/s3select/fallback.py``).  This rule flags any per-row
+``Node.eval(...)`` call — or a ``sql.execute(...)`` hand-off — inside
+the columnar scan modules outside that fallback module, so a future
+"quick fix" cannot quietly turn the scan engine back into a row loop.
+
+``# mtpu-lint: disable=R10 -- why`` is the justified-waiver escape
+hatch, as for every rule.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, terminal_name
+
+# The columnar scan path.  select.py (the orchestrator) is OUT of
+# scope on purpose: its job is exactly the whole-query row-oracle
+# hand-off (`sql.execute` on the explicit engine.Unsupported
+# fallback), which this rule forbids INSIDE the scan modules.
+_SCAN_PATHS = (
+    "minio_tpu/s3select/engine.py",
+    "minio_tpu/s3select/columnar.py",
+    "minio_tpu/s3select/compile.py",
+)
+
+
+class SelectScanRowEvalRule(Rule):
+    id = "R10"
+    title = ("no per-row Node.eval in the columnar scan path "
+             "(s3select/fallback.py is the designated row tier)")
+
+    def applies(self, ctx) -> bool:
+        return ctx.relpath in _SCAN_PATHS
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Attribute):
+            name = node.func.attr
+            if name == "eval":
+                self.flag(node, (
+                    "per-row Node.eval() in the columnar scan path — "
+                    "route undecided rows through "
+                    "s3select/fallback.py (the designated row tier) "
+                    "or vectorize the op"))
+            elif name == "execute" and \
+                    terminal_name(node.func.value) in ("sql",):
+                self.flag(node, (
+                    "sql.execute() inside the columnar scan path — "
+                    "whole-query row fallback belongs to the caller "
+                    "(select.py) via engine.Unsupported"))
+        self.generic_visit(node)

@@ -30,7 +30,7 @@ import urllib.error
 import urllib.request
 
 SPARK = "▁▂▃▄▅▆▇█"
-_CLASSES = ("read", "write", "list", "admin")
+_CLASSES = ("read", "write", "list", "admin", "select")
 _STATE_NAMES = {0: "UP", 1: "DEGRADED", 2: "DOWN"}
 # Codec-plan lane indices (ops/autotune.py plan_indices order =
 # kernprof BACKENDS), abbreviated for the one-line codec row.
@@ -165,6 +165,13 @@ def render(doc: dict, width: int = 60) -> str:
         f"fill/s {_num(last.get('cacheFills', 0) / dt(last))}  "
         f"hit% {ratio * 100:.1f}  "
         f"bytes {last.get('cacheBytes', 0) / (1 << 20):.1f} MiB")
+    # Analytics scan row (columnar S3 Select): queries + decoded
+    # GiB/s this window — the select lane's live throughput.
+    sp = last.get("selectProcessed", 0)
+    if sp or last.get("selectRequests", 0):
+        lines.append(
+            f"select: scans/s {_num(last.get('selectRequests', 0) / dt(last))}  "
+            f"scan {sp / dt(last) / (1 << 30):.3f} GiB/s")
     d = last.get("drives", {})
     lines.append(f"drives: suspect={d.get('suspect', 0)} "
                  f"faulty={d.get('faulty', 0)} "
