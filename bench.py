@@ -28,6 +28,12 @@ encode+decode GiB/s/chip (8+4, 1MiB blocks) — plus:
                                   off (paired off/on/off): GET QPS
                                   speedup, hit ratio, coalesced fills,
                                   p99, cache-off consult overhead
+     8. noisy_neighbor            one Zipf-hot tenant amid uniform
+                                  background through the multi-tenant
+                                  loadgen: admin /top ranks the hot
+                                  bucket, the noisy_neighbor watchdog
+                                  rule fires naming it and resolves,
+                                  paired usage-on/off PUT p50 <= 2%
   "stats":    batching.STATS snapshot (device-vs-host honesty counters)
   "errors":   per-config error strings (configs that failed still leave
               the others reported; the script never exits nonzero)
@@ -1020,6 +1026,190 @@ def bench_hot_get(np, workdir: str) -> dict:
             shutil.rmtree(base, ignore_errors=True)
 
 
+def bench_noisy_neighbor(np, workdir: str) -> dict:
+    """Tenant attribution plane end-to-end (obs/usage.py): one
+    Zipf-hot tenant amid uniform background, driven through the
+    multi-tenant loadgen against a capped write class so the hot
+    tenant causes real sheds.  Asserts the whole loop the plane
+    exists for:
+
+    1. admin /top ranks the injected hot bucket first, with a
+       worst-request trace-id exemplar that resolves in the slowlog;
+    2. the watchdog's noisy_neighbor built-in fires with the tenant
+       named in the cause, and resolves after the skew stops;
+    3. a paired usage-on/off PUT p50 stays within the PR-4 noise bar
+       (<= 2%) — attribution must be free on the hot path.
+    """
+    import statistics as stats
+
+    from minio_tpu.erasure.engine import ErasureObjects
+    from minio_tpu.obs.metrics2 import METRICS2
+    from minio_tpu.obs.usage import USAGE
+    from minio_tpu.obs.watchdog import WATCHDOG
+    from minio_tpu.s3.admin_client import AdminClient
+    from minio_tpu.s3.client import S3Client
+    from minio_tpu.s3.server import S3Server
+    from minio_tpu.storage.xl import XLStorage
+    from tools.loadgen import run_load
+
+    access, secret = "benchadmin", "benchadmin-secret"
+    base = workdir
+    if os.path.isdir("/dev/shm"):
+        # tmpfs like put_p50/hot_get: the paired p50 tracks the
+        # record() hook's CPU cost, not VM writeback noise.
+        base = tempfile.mkdtemp(prefix="minio-tpu-noisy-",
+                                dir="/dev/shm")
+    root = os.path.join(base, "cfg-noisy")
+    disks = [XLStorage(os.path.join(root, f"disk{i}"))
+             for i in range(6)]
+    layer = ErasureObjects(disks, 4, 2, block_size=1024 * 1024)
+    srv = S3Server(layer, access, secret)
+    port = srv.start()
+    n_tenants, write_cap = 4, 2
+    try:
+        USAGE.reset()
+        client = S3Client("127.0.0.1", port, access, secret)
+        adm = AdminClient("127.0.0.1", port, access, secret)
+        for i in range(n_tenants):
+            client.make_bucket(f"nz-{i}")
+        client.make_bucket("ovh")
+        rng = np.random.default_rng(15)
+        # 1MiB like qos_brownout: big enough that a 4x-cap overload
+        # piles queue waits past the deadline and actually SHEDS.
+        body = rng.integers(0, 256, 1024 * 1024).astype(
+            np.uint8).tobytes()
+        for i in range(4):  # warm compile/caches
+            client.put_object("ovh", f"warm-{i}", body)
+
+        # -- paired usage-on/off PUT p50 (off/on/off brackets drift) --
+        def put_lat(tag: str, n: int = 24) -> list[float]:
+            lat = []
+            for i in range(n):
+                t0 = time.perf_counter()
+                r = client.put_object("ovh", f"{tag}-{i}", body)
+                lat.append(time.perf_counter() - t0)
+                if r.status != 200:
+                    raise RuntimeError(f"PUT failed: {r.status}")
+            return lat
+
+        adm.set_config_kv("usage enable=off")
+        lat_off = put_lat("off1")
+        adm.set_config_kv("usage enable=on")
+        lat_on = put_lat("on")
+        adm.set_config_kv("usage enable=off")
+        lat_off += put_lat("off2")
+        adm.set_config_kv("usage enable=on")
+        p50_off = stats.median(lat_off) * 1e3
+        p50_on = stats.median(lat_on) * 1e3
+        overhead_pct = (p50_on - p50_off) / max(p50_off, 1e-9) * 100
+        if overhead_pct > 2.0:
+            raise RuntimeError(
+                f"usage-on PUT p50 overhead {overhead_pct:.2f}% "
+                f"exceeds the 2% noise bar "
+                f"(on {p50_on:.3f}ms vs off {p50_off:.3f}ms)")
+
+        # -- skewed fleet: Zipf-hot tenant 0 vs uniform background ----
+        USAGE.reset()
+        adm.set_config_kv("obs timeline_sample=250ms slow_ms=100")
+        adm.set_config_kv("usage fast_window=2s slow_window=10s "
+                          "noisy_share=0.5 noisy_min_requests=20")
+        adm.set_config_kv("alerts pending_ticks=2 resolve_ticks=2")
+        # ~12x the cap: the bounded wait queue (QUEUE_FACTOR x cap)
+        # overflows and the 100ms budget burns, so the overload SHEDS
+        # instead of merely queueing on a fast box.
+        adm.set_config_kv(f"api requests_max_write={write_cap} "
+                          "requests_deadline=100ms")
+        fired_before = METRICS2.get(
+            "minio_tpu_v2_alert_transitions_total",
+            {"rule": "noisy_neighbor", "state": "firing"}) or 0
+        load = run_load("127.0.0.1", port, access, secret, "nz",
+                        concurrency=12 * write_cap, duration=3.0,
+                        put_fraction=1.0, object_bytes=len(body),
+                        buckets=n_tenants, tenant_zipf_s=3.0, seed=15)
+        # The skew is still inside the fast window: give the sampler
+        # a moment to evaluate it before the caps lift.
+        fire_deadline = time.time() + 10
+        while (time.time() < fire_deadline
+               and (METRICS2.get(
+                   "minio_tpu_v2_alert_transitions_total",
+                   {"rule": "noisy_neighbor", "state": "firing"})
+                   or 0) <= fired_before):
+            time.sleep(0.25)
+        fired = (METRICS2.get(
+            "minio_tpu_v2_alert_transitions_total",
+            {"rule": "noisy_neighbor", "state": "firing"})
+            or 0) - fired_before
+        snap_alerts = {a["rule"]: a for a in
+                       WATCHDOG.snapshot()["alerts"]}
+        cause = snap_alerts.get("noisy_neighbor", {}).get("cause", "")
+        if fired < 1 or "nz-0" not in cause:
+            raise RuntimeError(
+                "noisy_neighbor never fired naming the hot tenant "
+                f"(fired={fired}, cause={cause!r}, "
+                f"shed_rate={load['shed_rate']})")
+
+        # -- admin /top names the hot bucket, exemplar -> slowlog -----
+        top = adm.top()
+        ranked = [b for b in top["buckets"]
+                  if b["name"].startswith("nz-")]
+        if not ranked or ranked[0]["name"] != "nz-0":
+            raise RuntimeError(
+                f"/top did not rank the hot tenant first: "
+                f"{[b['name'] for b in top['buckets']]}")
+        worst = ranked[0].get("worst", {})
+        if not worst.get("traceId"):
+            raise RuntimeError(f"/top carried no trace exemplar: "
+                               f"{ranked[0]}")
+        hot_keys = (top.get("keys") or {}).get("write", [])
+        if not any(k["key"].startswith("nz-0/") for k in hot_keys):
+            raise RuntimeError(
+                f"write-key sketch missed the hot bucket: {hot_keys}")
+
+        # -- resolve once the skew stops ------------------------------
+        adm.set_config_kv("api requests_max_write=0 "
+                          "requests_deadline=10s")
+        resolve_deadline = time.time() + 30
+        while (time.time() < resolve_deadline
+               and WATCHDOG.state_of("noisy_neighbor") != "ok"):
+            time.sleep(0.25)
+        if WATCHDOG.state_of("noisy_neighbor") != "ok":
+            raise RuntimeError(
+                "noisy_neighbor never resolved after the skew "
+                f"stopped: {WATCHDOG.snapshot()['alerts']}")
+
+        hot = (load.get("tenants") or {}).get("nz-0", {})
+        return {
+            "metric": "noisy_neighbor",
+            "value": round(hot.get("requests", 0)
+                           / max(load["requests"], 1), 4),
+            "unit": "hot_tenant_share",
+            "tenants": n_tenants, "write_cap": write_cap,
+            "requests": load["requests"],
+            "shed_503": load["shed_503"],
+            "per_tenant": load.get("tenants", {}),
+            "alert_fired": fired, "alert_cause": cause,
+            "alert_resolved": True,
+            "top_bucket": ranked[0]["name"],
+            "worst_trace_id": worst.get("traceId", ""),
+            "worst_in_slowlog": "slowlog" in worst,
+            "usage_folded": USAGE.folded_total,
+            "put_p50_usage_on_ms": round(p50_on, 3),
+            "put_p50_usage_off_ms": round(p50_off, 3),
+            "usage_overhead_pct": round(overhead_pct, 2),
+        }
+    finally:
+        USAGE.reset()
+        from minio_tpu.config.kv import DEFAULT_KVS
+        USAGE.configure(
+            top_k=int(DEFAULT_KVS["usage"]["top_k"]),
+            cardinality_cap=int(DEFAULT_KVS["usage"]
+                                ["cardinality_cap"]))
+        srv.stop()
+        shutil.rmtree(root, ignore_errors=True)
+        if base != workdir:
+            shutil.rmtree(base, ignore_errors=True)
+
+
 # --- config 9: crash recovery — kill -9 mid-PUT-loop, restart, recover -------
 
 
@@ -1846,6 +2036,8 @@ def main() -> None:
                       lambda: bench_qos_brownout(np, workdir)),
                      ("hot_get",
                       lambda: bench_hot_get(np, workdir)),
+                     ("noisy_neighbor",
+                      lambda: bench_noisy_neighbor(np, workdir)),
                      ("front_door",
                       lambda: bench_front_door(np, workdir)),
                      ("crash_recovery",

@@ -153,6 +153,21 @@ DEFAULT_KVS: dict[str, dict[str, str]] = {
         "webhook_endpoint": "",
         "webhook_auth_token": "",
     },
+    # Tenant/workload attribution (obs/usage.py): per-bucket/per-key
+    # exact accounts over fast/slow windows + SpaceSaving top-K
+    # sketches per QoS class. `cardinality_cap` bounds the distinct
+    # bucket/tenant names tracked (and the usage_* metric labels) —
+    # overflow folds into `_other`; `noisy_share`/`noisy_min_requests`
+    # tune the watchdog's noisy_neighbor built-in rule.
+    "usage": {
+        "enable": "on",
+        "top_k": "10",
+        "cardinality_cap": "64",
+        "fast_window": "1m",
+        "slow_window": "15m",
+        "noisy_share": "0.5",
+        "noisy_min_requests": "20",
+    },
     # Slow-request capture SLOs (obs/slowlog.py): any request past its
     # class threshold (ms) lands in the slowlog ring with per-layer
     # blame. Per-class keys override the default; empty = inherit;

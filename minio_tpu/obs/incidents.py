@@ -133,6 +133,13 @@ class IncidentRecorder:
             from .watchdog import WATCHDOG
             return WATCHDOG.snapshot()
 
+        def usage_census() -> dict:
+            # The attribution snapshot at capture time: WHO was the
+            # traffic when the alert fired — the noisy_neighbor rule's
+            # evidence, and the first question for any brownout.
+            from .usage import USAGE
+            return USAGE.snapshot()
+
         section("timeline", timeline_window)
         section("slowlog", slowlog_tail)
         section("worstTrace", worst_trace)
@@ -140,6 +147,7 @@ class IncidentRecorder:
         section("kernelBackends", backend_states)
         section("faultPlan", fault_plan)
         section("alerts", alert_census)
+        section("usage", usage_census)
         for name, provider in list(self.providers.items()):
             section(name, provider)
         if isinstance(bundle.get("config"), dict):
@@ -168,7 +176,8 @@ class IncidentRecorder:
         Returns the bundle's serialized size (stored so the index
         never re-serializes the ring to report byte counts)."""
         size = len(json.dumps(bundle, default=str))
-        for drop in ("worstTrace", "slowlog", "timeline", "config"):
+        for drop in ("worstTrace", "slowlog", "timeline", "usage",
+                     "config"):
             if size <= MAX_BYTES:
                 return size
             if drop in bundle:

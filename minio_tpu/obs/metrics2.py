@@ -23,6 +23,11 @@ import threading
 LATENCY_BUCKETS_MS = (0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500,
                       1000, 2500, 5000, 10000)
 
+# Cardinality-guard overflow counter: every fold of a capped label
+# value into "_other" lands here (registered in __init__ so every
+# registry instance — including test-local ones — carries it).
+_OVERFLOW = "minio_tpu_v2_metrics_label_overflow_total"
+
 
 class MetricsV2:
     """Thread-safe registry of counters and histograms."""
@@ -35,11 +40,24 @@ class MetricsV2:
         self._data: dict[str, dict[tuple, object]] = {}
         # labels_key -> labels dict (for rendering)
         self._labels: dict[tuple, dict] = {}
+        # Cardinality guard: name -> {label: cap}; a capped label's
+        # values past its cap fold into "_other" at recording time
+        # (see _guard) — the fix for the latent unbounded-cardinality
+        # risk of any per-bucket/per-tenant series.
+        self._cap_labels: dict[str, dict[str, int]] = {}
+        # (name, label) -> distinct values admitted so far
+        self._cap_seen: dict[tuple[str, str], set] = {}
+        self._specs[_OVERFLOW] = (
+            "counter",
+            "Capped-label values folded into _other by the "
+            "cardinality guard, by metric and label.", None)
+        self._data[_OVERFLOW] = {}
 
     # -- registration --------------------------------------------------
 
     def register(self, name: str, mtype: str, help_text: str,
-                 buckets: tuple | None = None) -> None:
+                 buckets: tuple | None = None,
+                 cap_labels: dict[str, int] | None = None) -> None:
         if mtype not in ("counter", "gauge", "histogram"):
             raise ValueError(f"bad metric type {mtype!r}")
         if mtype == "histogram" and buckets is None:
@@ -47,6 +65,21 @@ class MetricsV2:
         with self._mu:
             self._specs[name] = (mtype, help_text, buckets)
             self._data.setdefault(name, {})
+            if cap_labels:
+                self._cap_labels[name] = {
+                    lbl: max(1, int(cap))
+                    for lbl, cap in cap_labels.items()}
+
+    def set_label_cap(self, name: str, label: str, cap: int) -> None:
+        """Live-retune a label's cardinality cap (config-KV ``usage
+        cardinality_cap``).  Already-admitted values keep their series
+        (shrinking the cap only folds NEW values — re-labeling live
+        counters would corrupt the deltas every scraper holds)."""
+        with self._mu:
+            if name not in self._specs:
+                raise ValueError(f"unregistered metric {name!r}")
+            self._cap_labels.setdefault(name, {})[label] = \
+                max(1, int(cap))
 
     def registered_names(self) -> set[str]:
         with self._mu:
@@ -73,6 +106,36 @@ class MetricsV2:
             raise ValueError(f"{name} is a {spec[0]}, not {want}")
         return spec
 
+    def _guard(self, name: str, labels: dict | None) -> dict | None:
+        """Apply the cardinality cap (caller holds the lock): for each
+        capped label, a value past the cap rewrites to "_other" and
+        counts into metrics_label_overflow_total — so a hostile or
+        runaway keyspace can never grow a capped series unboundedly,
+        and the fold is itself observable."""
+        caps = self._cap_labels.get(name)
+        if not caps or not labels:
+            return labels
+        out = None
+        for lbl, cap in caps.items():
+            v = labels.get(lbl)
+            if v is None or v == "_other":
+                continue
+            seen = self._cap_seen.setdefault((name, lbl), set())
+            if v in seen:
+                continue
+            if len(seen) < cap:
+                seen.add(v)
+                continue
+            if out is None:
+                out = dict(labels)
+            out[lbl] = "_other"
+            # Direct write (we already hold the lock; inc() would
+            # deadlock) — the overflow counter is registered below.
+            series = self._data[_OVERFLOW]
+            okey = self._key({"metric": name, "label": lbl})
+            series[okey] = series.get(okey, 0) + 1
+        return out if out is not None else labels
+
     # -- recording -----------------------------------------------------
 
     def inc(self, name: str, labels: dict | None = None,
@@ -80,21 +143,21 @@ class MetricsV2:
         with self._mu:
             self._spec(name, ("counter", "gauge"))
             series = self._data[name]
-            key = self._key(labels)
+            key = self._key(self._guard(name, labels))
             series[key] = series.get(key, 0) + v
 
     def set_gauge(self, name: str, labels: dict | None = None,
                   v: float = 0) -> None:
         with self._mu:
             self._spec(name, ("gauge",))
-            self._data[name][self._key(labels)] = v
+            self._data[name][self._key(self._guard(name, labels))] = v
 
     def observe(self, name: str, labels: dict | None = None,
                 v: float = 0.0) -> None:
         with self._mu:
             _, _, buckets = self._spec(name, ("histogram",))
             series = self._data[name]
-            key = self._key(labels)
+            key = self._key(self._guard(name, labels))
             h = series.get(key)
             if h is None:
                 h = series[key] = [[0] * (len(buckets) + 1), 0.0, 0]
@@ -142,6 +205,11 @@ class MetricsV2:
         with self._mu:
             for name in self._data:
                 self._data[name] = {}
+            # The cardinality guard resets with the series it guards:
+            # stale seen-sets would fold post-reset traffic against
+            # ghost admissions (new values denied their own series by
+            # names that no longer exist in the registry).
+            self._cap_seen.clear()
 
 
 def merge(*snapshots: dict) -> dict:
@@ -508,3 +576,40 @@ METRICS2.register(
     "Rows the columnar scan routed through the row-engine fallback "
     "(division by zero, exact-integer overflow, complex LIKE, "
     "row-tier batches) — exactness escapes, not errors.")
+# Tenant/workload attribution (obs/usage.py). Every dynamic label
+# (bucket, tenant) is CAPPED: values past the cap fold into "_other"
+# and count into metrics_label_overflow_total — the cap follows the
+# usage subsystem's cardinality_cap on live reload (set_label_cap).
+_USAGE_CAP = 64
+METRICS2.register(
+    "minio_tpu_v2_usage_requests_total", "counter",
+    "S3 requests attributed per bucket and QoS class "
+    "(cardinality-capped; overflow folds into _other).",
+    cap_labels={"bucket": _USAGE_CAP})
+METRICS2.register(
+    "minio_tpu_v2_usage_rx_bytes_total", "counter",
+    "Request body bytes received, per bucket (capped).",
+    cap_labels={"bucket": _USAGE_CAP})
+METRICS2.register(
+    "minio_tpu_v2_usage_tx_bytes_total", "counter",
+    "Response body bytes sent, per bucket (capped).",
+    cap_labels={"bucket": _USAGE_CAP})
+METRICS2.register(
+    "minio_tpu_v2_usage_errors_total", "counter",
+    "Non-shed 5xx answers, per bucket (capped).",
+    cap_labels={"bucket": _USAGE_CAP})
+METRICS2.register(
+    "minio_tpu_v2_usage_shed_total", "counter",
+    "503 SlowDown sheds / burnt deadlines, per bucket (capped) — "
+    "the noisy_neighbor rule's per-tenant shed numerator.",
+    cap_labels={"bucket": _USAGE_CAP})
+METRICS2.register(
+    "minio_tpu_v2_usage_tenant_requests_total", "counter",
+    "S3 requests attributed per access key and QoS class (capped; "
+    "tenant ids ride REDACTED — the registry renders on the "
+    "unauthenticated metrics pages).",
+    cap_labels={"tenant": _USAGE_CAP})
+METRICS2.register(
+    _OVERFLOW, "counter",
+    "Capped-label values folded into _other by the cardinality "
+    "guard, by metric and label.")

@@ -256,6 +256,10 @@ class Timeline:
                            len(DRIVEMON.quarantined_endpoints())},
             "backendState": KERNPROF.states(),
             "codecPlan": _codec_plan(),
+            # Attribution census (obs/usage.py): the fast window's top
+            # bucket per QoS class — gauge-like, not delta'd, so a
+            # timeline spike names WHO drove it without a /usage call.
+            "usageTop": _usage_top(),
         }
 
     def tick(self, now: float | None = None) -> dict | None:
@@ -345,6 +349,9 @@ class Timeline:
                 # so a plan flip is visible in the same ring as the
                 # backend-state flip that usually caused it.
                 "codecPlan": dict(raw.get("codecPlan") or {}),
+                # Attribution census (gauge-like, like alerts): the
+                # fast window's top bucket per class at sample time.
+                "usageTop": dict(raw.get("usageTop") or {}),
                 # Alert census at sample time (the watchdog evaluates
                 # AFTER each tick, so this reflects the previous
                 # evaluation — one period of honest lag).
@@ -408,6 +415,11 @@ def _codec_plan() -> dict[str, int]:
     return AUTOTUNE.plan_indices()
 
 
+def _usage_top() -> dict:
+    from .usage import USAGE
+    return USAGE.class_top_shares()
+
+
 def _bucket(t: float, period_s: float) -> float:
     return round(int(t / period_s) * period_s, 3)
 
@@ -454,6 +466,8 @@ def _collapse_node(snap: dict, period_s: float) -> list[dict]:
             "alerts": dict(last.get("alerts") or {}),
             # Census like alerts: the bucket's latest codec plan.
             "codecPlan": dict(last.get("codecPlan") or {}),
+            # Census: the bucket's latest attribution shares.
+            "usageTop": dict(last.get("usageTop") or {}),
             "backendState": {},
         }
         for s in group:
@@ -520,6 +534,7 @@ def merge_timelines(snapshots: list[dict],
                     "alerts": {"firing": 0, "pending": 0,
                                "worst": ""},
                     "codecPlan": {},
+                    "usageTop": {},
                     "backendState": {},
                 }
             cur["nodes"] += int(s.get("nodes", 1))
@@ -554,6 +569,15 @@ def merge_timelines(snapshots: list[dict],
             for k, v in (s.get("codecPlan") or {}).items():
                 cur["codecPlan"][k] = max(cur["codecPlan"].get(k, 0),
                                           v)
+            # Per-class WORST concentration across nodes: the cluster
+            # row names the bucket with the highest single-node share
+            # (an exact cross-node merge lives on /usage/cluster; the
+            # timeline census is the headline, like alerts.worst).
+            for cls, top in (s.get("usageTop") or {}).items():
+                cur_top = cur["usageTop"].get(cls)
+                if cur_top is None or top.get("share", 0) > \
+                        cur_top.get("share", 0):
+                    cur["usageTop"][cls] = dict(top)
             w = s.get("worstRequest")
             if w and w.get("durationMs", 0) > cur.get(
                     "worstRequest", {}).get("durationMs", -1):

@@ -316,6 +316,69 @@ class ResetRule:
                 "(restart/registry-reset storm)", float(n))
 
 
+class NoisyNeighborRule:
+    """Workload-attribution rule (obs/usage.py): ONE bucket or tenant
+    carrying more than ``usage noisy_share`` of a QoS class's admitted
+    requests — or of its sheds — over BOTH usage windows (fast reacts,
+    slow confirms, same two-window discipline as the burn rules),
+    while the class is actually SHEDDING and at least one other
+    entity shares it (skew without contention, or a class with a
+    single tenant, is a workload shape, not an incident).
+    The cause NAMES the tenant, which is what turns the alert into an
+    input the per-class QoS caps (or a future per-tenant throttle)
+    can act on; firing freezes the usage snapshot into the incident
+    bundle (obs/incidents.py carries a ``usage`` section)."""
+
+    name = "noisy_neighbor"
+    kind = "event"
+
+    def evaluate(self, ctx: _EvalCtx):
+        from .usage import USAGE
+        if not USAGE.enabled:
+            return False, "", 0.0
+        fast = USAGE.class_shares(USAGE.fast_s, ctx.now)
+        slow = USAGE.class_shares(USAGE.slow_s, ctx.now)
+        share_min = USAGE.noisy_share
+        vol_min = USAGE.noisy_min_requests
+        worst = None  # (share, cause)
+        for cls, fdoc in fast.items():
+            sdoc = slow.get(cls) or {}
+            # Two gates before any share matters: the class must be
+            # SHEDDING in the fast window (a dominant tenant in an
+            # uncontended class harms nobody — and healthy one-bucket
+            # traffic must never page), and there must be >= 2
+            # distinct entities (no neighbor, no noisy neighbor).
+            if fdoc.get("shed", 0) <= 0:
+                continue
+            for key, denom, count_key, what in (
+                    ("topBucket", "admitted", "bucketCount",
+                     "admitted requests"),
+                    ("topTenant", "admitted", "tenantCount",
+                     "admitted requests"),
+                    ("topShedBucket", "shed", "bucketCount", "sheds"),
+                    ("topShedTenant", "shed", "tenantCount", "sheds")):
+                f = fdoc.get(key)
+                s = sdoc.get(key)
+                if (f is None or s is None
+                        or f.get("name") != s.get("name")
+                        or fdoc.get(count_key, 0) < 2
+                        or fdoc.get(denom, 0) < vol_min
+                        or f.get("share", 0.0) < share_min
+                        or s.get("share", 0.0) < share_min):
+                    continue
+                kind = "tenant" if "Tenant" in key else "bucket"
+                cause = (f"{kind} {f['name']!r} carries "
+                         f"{f['share']:.2f} of {cls} {what} "
+                         f"(fast {USAGE.fast_s:g}s) / "
+                         f"{s['share']:.2f} (slow {USAGE.slow_s:g}s)"
+                         f" >= {share_min:g}")
+                if worst is None or f["share"] >= worst[0]:
+                    worst = (f["share"], cause)
+        if worst is None:
+            return False, "", 0.0
+        return True, worst[1], round(worst[0], 4)
+
+
 class ThresholdRule:
     """User-defined threshold over any registered metrics-v2 series
     (config-KV ``alerts rules``): sum of every series of ``metric``
@@ -388,7 +451,8 @@ def validate_user_rules(raw: str) -> list[dict]:
     registered = METRICS2.registered_names()
     builtin = {name for name, _, _ in BURN_SIGNALS} | {
         DriveRule.name, BackendRule.name, MrfRule.name,
-        RecoveryRule.name, CacheRule.name, ResetRule.name}
+        RecoveryRule.name, CacheRule.name, ResetRule.name,
+        NoisyNeighborRule.name}
     seen: set[str] = set()
     out: list[dict] = []
     for i, r in enumerate(doc):
@@ -603,7 +667,8 @@ class Watchdog:
         for name, key, what in BURN_SIGNALS:
             rules[name] = BurnRule(name, key, what)
         for r in (DriveRule(), BackendRule(), MrfRule(),
-                  RecoveryRule(), CacheRule(), ResetRule()):
+                  RecoveryRule(), CacheRule(), ResetRule(),
+                  NoisyNeighborRule()):
             rules[r.name] = r
         for doc in user_docs:
             r = ThresholdRule(doc)

@@ -164,6 +164,14 @@ class PeerRPCService:
         from ..obs.watchdog import WATCHDOG
         return ({"alerts": WATCHDOG.snapshot()}, b"")
 
+    def rpc_usage(self, args: dict, payload: bytes):
+        """This node's workload-attribution snapshot (obs/usage.py)
+        for the cluster usage endpoint's fan-in merge — accounts sum,
+        sketches merge via their count-min backing.  Needs no server
+        binding: the accountant is process-wide."""
+        from ..obs.usage import USAGE
+        return ({"usage": USAGE.snapshot()}, b"")
+
     def rpc_server_info(self, args: dict, payload: bytes):
         srv = self._server()
         return ({"version": __version__,
@@ -410,6 +418,13 @@ class NotificationSys:
         counts them as unreachable, never as alert-free)."""
         return {k: (v if isinstance(v, dict) else {"error": str(v)})
                 for k, v in self._fanout("alerts", {}).items()}
+
+    def usage_all(self) -> dict:
+        """Per-peer usage snapshots for the cluster attribution merge
+        (unreachable peers degrade to an error entry — the endpoint
+        counts them as unreachable, never as idle)."""
+        return {k: (v if isinstance(v, dict) else {"error": str(v)})
+                for k, v in self._fanout("usage", {}).items()}
 
     def server_info_all(self) -> dict:
         return {k: (v if isinstance(v, dict) else {"error": str(v)})

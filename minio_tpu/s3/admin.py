@@ -190,6 +190,36 @@ class AdminHandlers:
         return {"lastUpdate": cached.get("lastUpdate", 0.0),
                 "buckets": buckets}
 
+    def h_top(self, p, body):
+        """`mc admin top` analog (obs/usage.py): ranked buckets and
+        tenants over the usage windows, per-class top-K object keys
+        and client addresses from the heavy-hitter sketches — joined
+        with the crawler's at-rest census (`storedBytes`, so live
+        traffic and footprint land in one report) and with the PR-4
+        slowlog: a bucket's worst-request trace-id exemplar is
+        annotated with its slowlog blame when the capture ring still
+        holds it.  Root-only, so tenants/clients are un-redacted
+        (the anonymous /minio-tpu/v2/usage surface redacts them)."""
+        from ..obs.slowlog import SLOWLOG
+        from ..obs.usage import USAGE
+        n = int(p.get("n", "0") or 0)
+        doc = USAGE.top(n if n > 0 else None)
+        crawler = getattr(self.server, "crawler", None)
+        sizes = crawler.bucket_sizes() if crawler is not None else {}
+        captured = {e.get("requestID"): e
+                    for e in SLOWLOG.entries(n=SLOWLOG.RING_SIZE)}
+        for row in doc["buckets"]:
+            if row["name"] in sizes:
+                row["storedBytes"] = sizes[row["name"]]
+            worst = row.get("worst")
+            if worst:
+                hit = captured.get(worst.get("traceId"))
+                if hit is not None:
+                    worst["slowlog"] = {
+                        "blamedLayer": hit.get("blamedLayer", ""),
+                        "statusCode": hit.get("statusCode", 0)}
+        return doc
+
     # -- users / policies ----------------------------------------------
 
     def _iam(self):

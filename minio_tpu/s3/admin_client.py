@@ -41,6 +41,12 @@ class AdminClient:
     def data_usage(self) -> dict:
         return self._call("GET", "datausage")
 
+    def top(self, n: int = 0) -> dict:
+        """Workload attribution report (`mc admin top` analog): ranked
+        buckets/tenants, per-class top-K keys/clients, stored-bytes
+        join, worst-request trace exemplars."""
+        return self._call("GET", "top", {"n": str(n)} if n else {})
+
     def obd_info(self, drive_perf: bool = False) -> dict:
         return self._call("GET", "obd-info",
                           {"drivePerf": "true"} if drive_perf else {})

@@ -2347,6 +2347,57 @@ class S3Server:
                         f"slow_window ({slow:g}s) — both windows must "
                         "breach for a burn alert, so a fast window "
                         "wider than the slow one would never confirm")
+        if subsys == "usage":
+            from ..qos.deadline import parse_duration
+            for key, v in kvs.items():
+                if key == "enable":
+                    if v not in ("on", "off"):
+                        raise ValueError(
+                            f"usage enable={v!r}: must be on/off")
+                elif key in ("top_k", "cardinality_cap",
+                             "noisy_min_requests"):
+                    caps = {"top_k": 1024, "cardinality_cap": 100_000,
+                            "noisy_min_requests": 10_000_000}
+                    try:
+                        if not 1 <= int(v) <= caps[key]:
+                            raise ValueError
+                    except ValueError:
+                        raise ValueError(
+                            f"usage {key}={v!r}: must be an integer "
+                            f"in [1, {caps[key]}]")
+                elif key in ("fast_window", "slow_window"):
+                    try:
+                        if parse_duration(v) <= 0:
+                            raise ValueError
+                    except ValueError:
+                        raise ValueError(
+                            f"usage {key}={v!r}: must be a positive "
+                            "duration like 30s / 1m / 15m")
+                elif key == "noisy_share":
+                    try:
+                        if not 0 < float(v) <= 1:
+                            raise ValueError
+                    except ValueError:
+                        raise ValueError(
+                            f"usage noisy_share={v!r}: must be a "
+                            "fraction in (0, 1]")
+            # Same two-window cross-check as the alerts subsystem:
+            # fast reacts, slow confirms — a fast window wider than
+            # the slow one would make noisy_neighbor never confirm.
+            if "fast_window" in kvs or "slow_window" in kvs:
+                try:
+                    fast = parse_duration(
+                        kvs.get("fast_window")
+                        or self.config.get("usage", "fast_window"))
+                    slow = parse_duration(
+                        kvs.get("slow_window")
+                        or self.config.get("usage", "slow_window"))
+                except ValueError:
+                    fast = slow = 0.0  # per-key checks already raised
+                if fast and slow and fast > slow:
+                    raise ValueError(
+                        f"usage fast_window ({fast:g}s) must be <= "
+                        f"slow_window ({slow:g}s)")
         if subsys == "cache":
             from ..qos.deadline import parse_duration
             for key, v in kvs.items():
@@ -2607,6 +2658,28 @@ class S3Server:
                 Logger.get().log_once(
                     f"alerts config invalid, keeping previous: {e}",
                     "config")
+        # Tenant/workload attribution reloads live (obs/usage.py):
+        # enable toggles the _finish_request hook, top_k reshapes the
+        # sketches, cardinality_cap retunes both the account fold and
+        # the metrics2 usage_* label guard, the windows and noisy_*
+        # knobs retune the noisy_neighbor rule.
+        from ..obs.usage import USAGE
+        try:
+            USAGE.configure(
+                enable=cfg.get("usage", "enable") == "on",
+                top_k=int(cfg.get("usage", "top_k")),
+                cardinality_cap=int(cfg.get("usage",
+                                            "cardinality_cap")),
+                fast_s=parse_duration(cfg.get("usage", "fast_window")),
+                slow_s=parse_duration(cfg.get("usage", "slow_window")),
+                noisy_share=float(cfg.get("usage", "noisy_share")),
+                noisy_min_requests=int(
+                    cfg.get("usage", "noisy_min_requests")))
+        except ValueError as e:  # env override may carry garbage
+            from ..logger import Logger
+            Logger.get().log_once(
+                f"usage config invalid, keeping previous: {e}",
+                "config")
         # Codec autotuner knobs reload live (ops/autotune.py):
         # autotune=off pins the static policy, hysteresis retunes the
         # plan-flip margin.
@@ -3128,6 +3201,17 @@ class S3Server:
                     _json.dumps(WATCHDOG.snapshot()).encode())
         if raw_path == "/minio-tpu/v2/alerts/cluster":
             return self._alerts_cluster()
+        if raw_path == "/minio-tpu/v2/usage":
+            # Node workload attribution (obs/usage.py): per-bucket/
+            # per-tenant window accounts + per-class heavy-hitter
+            # sketches. Unauthenticated like the metrics pages, so
+            # access keys, client addresses and object-key tails are
+            # redacted — admin /top serves them whole.
+            from ..obs.usage import USAGE, redact_usage
+            return (200, "application/json", _json.dumps(
+                redact_usage(USAGE.snapshot())).encode())
+        if raw_path == "/minio-tpu/v2/usage/cluster":
+            return self._usage_cluster()
         if raw_path in ("/minio-tpu/console", "/minio-tpu/console/") \
                 and method == "GET":
             from .console import console_response
@@ -3327,6 +3411,39 @@ class S3Server:
             return _json.dumps(doc).encode()
 
         body = self._cached_cluster_scrape("_cluster_alerts_cache",
+                                           build)
+        return 200, "application/json", body
+
+    _cluster_usage_cache: tuple[float, bytes] | None = None
+
+    def _usage_cluster(self) -> tuple[int, str, bytes]:
+        """Cluster workload attribution: this node's usage snapshot
+        merged with every peer's (scraped over the `usage` peer RPC)
+        — accounts sum per name, heavy-hitter sketches merge with the
+        count-min backing, and the node count is HONEST: unreachable
+        peers are reported as such instead of silently reading as
+        idle (same TTL-cached fan-in shape as metrics2/alerts)."""
+        import json as _json
+        from ..obs.usage import USAGE, merge_usage, redact_usage
+
+        def build() -> bytes:
+            named = [("local", USAGE.snapshot())]
+            unreachable = 0
+            if self.notification is not None:
+                for i, (key, res) in enumerate(
+                        sorted(self.notification.usage_all()
+                               .items())):
+                    snap = res.get("usage") if isinstance(res, dict) \
+                        else None
+                    if isinstance(snap, dict):
+                        named.append((f"peer{i}", snap))
+                    else:
+                        unreachable += 1
+            doc = merge_usage(named)
+            doc["unreachable"] = unreachable
+            return _json.dumps(redact_usage(doc)).encode()
+
+        body = self._cached_cluster_scrape("_cluster_usage_cache",
                                            build)
         return 200, "application/json", body
 
@@ -3803,6 +3920,28 @@ class S3Server:
                         None, resp_len)
                 server.bandwidth.record(req.bucket, length,
                                         resp_len)
+                # Workload attribution (obs/usage.py): who was
+                # this request — bucket/tenant accounts, per-class
+                # key/client heavy-hitter sketches, usage_* series.
+                # Sheds/burnt deadlines count as shed, not error,
+                # mirroring the slowlog exemption split.
+                from ..obs.usage import (USAGE,
+                                         claimed_access_key)
+                USAGE.record(
+                    bucket=req.bucket,
+                    access_key=(getattr(req, "access_key", "")
+                                or claimed_access_key(
+                                    headers.get("authorization",
+                                                ""),
+                                    req.params)),
+                    qos_class=req.qos_class or "read",
+                    rx=length, tx=resp_len,
+                    status=resp.status,
+                    shed=(resp.status >= 500
+                          and req.slowlog_exempt),
+                    key=req.key, client=txn.client_ip,
+                    duration_ms=dur_ms,
+                    trace_id=req.request_id)
                 # Slow-request capture: over-SLO or 5xx lands
                 # the full span tree + QoS data in the slowlog
                 # ring, annotated with the blamed layer

@@ -329,3 +329,13 @@ class DataCrawler:
     def data_usage(self) -> dict:
         with self._mu:
             return dict(self.last_usage)
+
+    def bucket_sizes(self) -> dict[str, int]:
+        """{bucket: logical at-rest bytes} from the last cycle — the
+        stored-bytes half of admin /top's live-traffic + footprint
+        join (obs/usage.py owns the live half)."""
+        with self._mu:
+            buckets = (self.last_usage or {}).get("buckets", {})
+            return {name: int(v.get("size", 0) or 0)
+                    for name, v in buckets.items()
+                    if isinstance(v, dict)}

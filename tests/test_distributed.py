@@ -330,6 +330,40 @@ def test_cluster_metrics_fan_in(cluster):
         assert "rs" in v and "bitrot" in v
 
 
+def test_usage_cluster_fan_in_merges_sketches(cluster):
+    """/minio-tpu/v2/usage/cluster over the real `usage` peer RPC:
+    two nodes answer, the node count is honest, accounts and key
+    sketches merge. (In-process nodes share the process-wide
+    accountant, so the merge sees the same traffic from both — what
+    this proves is the wire plumbing, the merge shape, and the
+    honest counting, on real sockets.)"""
+    import json as _json
+    import urllib.request
+
+    from minio_tpu.obs.usage import USAGE
+    servers, ports, nodes, tmp = cluster
+    _wire_peer_plane(servers, nodes)
+    USAGE.reset()
+    c0 = S3Client("127.0.0.1", ports[0], ACCESS, SECRET)
+    c0.make_bucket("usagecl")
+    for i in range(6):
+        assert c0.put_object("usagecl", f"u{i % 2}",
+                             os.urandom(8192)).status == 200
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{ports[0]}/minio-tpu/v2/usage/cluster",
+            timeout=10) as r:
+        doc = _json.loads(r.read().decode())
+    assert doc["nodes"] == 2
+    assert doc["unreachable"] == 0
+    # Both nodes contributed (the shared accountant counts twice).
+    assert doc["buckets"]["slow"]["usagecl"]["requests"] >= 12
+    assert doc["totals"]["requests"] >= 12
+    sk = doc["sketches"]["key"]["write"]
+    assert any(c["key"].startswith("usagecl/")
+               for c in sk["counters"]), sk
+    USAGE.reset()
+
+
 def test_iam_deletion_propagates(cluster):
     """remove_user on node A revokes the credential on node B — load()
     must REBUILD (not merge), or revoked keys stay valid forever."""

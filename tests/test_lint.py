@@ -805,6 +805,37 @@ def test_o9_select_literal_recording_calls():
         _ctx(bad, "minio_tpu/ops/batching.py"))
 
 
+def test_o10_usage_literal_recording_calls():
+    from tools.mtpu_lint.rules.obs import UsageMetricCallRule
+    # POSITIVE: dynamic name + unregistered usage_* literal.
+    bad = ("def f(kind):\n"
+           "    METRICS2.inc('minio_tpu_v2_usage_' + kind)\n"
+           "    METRICS2.inc('minio_tpu_v2_usage_bogus_total',"
+           " {'bucket': 'b'})\n")
+    assert len(_check(UsageMetricCallRule(), bad,
+                      "minio_tpu/obs/usage.py")) == 2
+    # NEGATIVE: the real usage_* series (and the cardinality-guard
+    # overflow counter) are registered.
+    good = ("def f(bucket, cls):\n"
+            "    METRICS2.inc('minio_tpu_v2_usage_requests_total',"
+            " {'bucket': bucket, 'class': cls})\n"
+            "    METRICS2.inc('minio_tpu_v2_usage_rx_bytes_total',"
+            " {'bucket': bucket}, 100)\n"
+            "    METRICS2.inc('minio_tpu_v2_usage_shed_total',"
+            " {'bucket': bucket})\n"
+            "    METRICS2.inc("
+            "'minio_tpu_v2_usage_tenant_requests_total',"
+            " {'tenant': 'ak', 'class': cls})\n"
+            "    METRICS2.inc("
+            "'minio_tpu_v2_metrics_label_overflow_total',"
+            " {'metric': 'm', 'label': 'bucket'})\n")
+    assert _check(UsageMetricCallRule(), good,
+                  "minio_tpu/obs/usage.py") == []
+    # Out of scope: the rule does not apply elsewhere in obs/.
+    assert not UsageMetricCallRule().applies(
+        _ctx(bad, "minio_tpu/obs/timeline.py"))
+
+
 # ---------------------------------------------------------------------------
 # Framework: suppressions, baseline, output modes
 

@@ -102,7 +102,9 @@ def run_load(host: str, port: int, access_key: str, secret_key: str,
              qps: float = 0.0, put_fraction: float = 0.5,
              object_bytes: int = 1024 * 1024, key_prefix: str = "loadgen",
              key_space: int = 32, seed: int = 0,
-             zipf_s: float = 0.0, preload: bool = False) -> dict:
+             zipf_s: float = 0.0, preload: bool = False,
+             buckets: int | list = 1, access_keys: list | None = None,
+             tenant_zipf_s: float = 0.0) -> dict:
     """Drive mixed PUT/GET load; returns the aggregate report dict.
 
     GETs address keys the run has already PUT (a GET before any PUT
@@ -117,24 +119,57 @@ def run_load(host: str, port: int, access_key: str, secret_key: str,
     cache benchmarks; the report then carries the achieved per-key
     concentration (``key_distribution``). ``preload`` PUTs the whole
     key space once before the timed window (outside the stats), so a
-    pure-GET Zipfian run never 404s."""
+    pure-GET Zipfian run never 404s.
+
+    **Multi-tenant mode**: ``buckets`` (an int N -> ``{bucket}-0`` ..
+    ``{bucket}-{N-1}``, or an explicit name list) and/or
+    ``access_keys`` (a list of ``(access, secret)`` pairs) define a
+    tenant fleet; tenant i uses bucket ``i % len(buckets)`` and
+    credential ``i % len(access_keys)``.  ``tenant_zipf_s`` > 0 skews
+    the PER-TENANT request mix Zipfian (tenant 0 hottest) — the
+    noisy-neighbor fleet shape — and the report carries per-tenant
+    request counts and latency percentiles (``tenants``), so the
+    bench can judge what the hot tenant did to everyone else."""
     from minio_tpu.s3.client import S3Client
 
     body = bytes(bytearray(random.Random(seed).randbytes(object_bytes))
                  ) if object_bytes else b""
     zipf = _Zipf(zipf_s, key_space) if zipf_s > 0 else None
+    if isinstance(buckets, int):
+        bucket_names = ([bucket] if buckets <= 1
+                        else [f"{bucket}-{i}" for i in range(buckets)])
+    else:
+        bucket_names = list(buckets) or [bucket]
+    creds = [(ak, sk) for ak, sk in (access_keys
+                                     or [(access_key, secret_key)])]
+    n_tenants = max(len(bucket_names), len(creds))
+
+    def tenant(i: int) -> tuple[str, tuple[str, str]]:
+        return (bucket_names[i % len(bucket_names)],
+                creds[i % len(creds)])
+
+    def tenant_label(i: int) -> str:
+        bkt, (ak, _) = tenant(i)
+        return bkt if len(creds) == 1 else f"{bkt}|{ak}"
+
+    tzipf = (_Zipf(tenant_zipf_s, n_tenants)
+             if tenant_zipf_s > 0 and n_tenants > 1 else None)
     if preload:
         # Preloaded keys live in a SHARED namespace every worker GETs
         # from (z{rank} for Zipf, p{n} uniform) — per-worker {wid}-{n}
-        # names would leave every worker but one 404ing.
+        # names would leave every worker but one 404ing. Every
+        # tenant's bucket gets the key space (root creds: the fleet's
+        # keys may not be allowed to PUT each other's buckets).
         pre = S3Client(host, port, access_key, secret_key)
-        for r in range(key_space):
-            key = (f"{key_prefix}/z{r}" if zipf is not None
-                   else f"{key_prefix}/p{r}")
-            resp = pre.put_object(bucket, key, body)
-            if resp.status != 200:
-                raise RuntimeError(
-                    f"preload PUT {key} failed: {resp.status}")
+        for bkt in bucket_names:
+            for r in range(key_space):
+                key = (f"{key_prefix}/z{r}" if zipf is not None
+                       else f"{key_prefix}/p{r}")
+                resp = pre.put_object(bkt, key, body)
+                if resp.status != 200:
+                    raise RuntimeError(
+                        f"preload PUT {bkt}/{key} failed: "
+                        f"{resp.status}")
     pacer = _Pacer(qps)
     stop_at = time.monotonic() + duration
     mu = threading.Lock()
@@ -143,15 +178,27 @@ def run_load(host: str, port: int, access_key: str, secret_key: str,
     status_counts: dict[int, int] = {}
     error_codes: dict[str, int] = {}
     key_counts: dict[str, int] = {}
-    put_keys: list[str] = []
+    # Per-bucket bootstrap pools + per-tenant stats (multi-tenant).
+    put_keys: dict[str, list[str]] = {b: [] for b in bucket_names}
+    tstats: dict[int, dict] = {
+        i: {"lat_ok": [], "requests": 0, "ok": 0, "shed_503": 0}
+        for i in range(n_tenants)}
     retry_after_seen = 0
 
     def worker(wid: int) -> None:
         nonlocal retry_after_seen
         rng = random.Random(seed * 1000 + wid)
-        client = S3Client(host, port, access_key, secret_key)
+        clients: dict[int, S3Client] = {}
         while time.monotonic() < stop_at:
             pacer.wait()
+            ti = (tzipf.sample(rng) if tzipf is not None
+                  else rng.randrange(n_tenants)) if n_tenants > 1 else 0
+            bkt, cred = tenant(ti)
+            ci = ti % len(creds)
+            client = clients.get(ci)
+            if client is None:
+                client = clients[ci] = S3Client(host, port, *cred)
+            pool = put_keys[bkt]
             # Bootstrap fallback: a GET with nothing to read yet PUTs
             # instead, so the classic mix self-starts on an empty
             # bucket. Zipf and preload runs assume the shared key
@@ -159,7 +206,7 @@ def run_load(host: str, port: int, access_key: str, secret_key: str,
             # would invalidate the very hot keys a cache bench just
             # warmed.
             do_put = rng.random() < put_fraction or (
-                not put_keys and not preload and zipf is None)
+                not pool and not preload and zipf is None)
             if zipf is not None:
                 key = f"{key_prefix}/z{zipf.sample(rng)}"
             elif preload and not do_put:
@@ -169,16 +216,15 @@ def run_load(host: str, port: int, access_key: str, secret_key: str,
             t0 = time.perf_counter()
             try:
                 if do_put:
-                    r = client.put_object(bucket, key, body)
+                    r = client.put_object(bkt, key, body)
                 else:
                     if zipf is not None or preload:
                         gkey = key
                     else:
                         with mu:
-                            gkey = rng.choice(put_keys) if put_keys \
-                                else key
+                            gkey = rng.choice(pool) if pool else key
                     key = gkey   # report the key actually requested
-                    r = client.get_object(bucket, gkey)
+                    r = client.get_object(bkt, gkey)
                 status = r.status
             except Exception:
                 status = -1
@@ -186,13 +232,20 @@ def run_load(host: str, port: int, access_key: str, secret_key: str,
             ms = (time.perf_counter() - t0) * 1e3
             with mu:
                 status_counts[status] = status_counts.get(status, 0) + 1
-                key_counts[key] = key_counts.get(key, 0) + 1
+                key_counts[f"{bkt}/{key}"] = \
+                    key_counts.get(f"{bkt}/{key}", 0) + 1
+                ts = tstats[ti]
+                ts["requests"] += 1
                 if 200 <= status < 300:
                     lat_ok.append(ms)
+                    ts["ok"] += 1
+                    ts["lat_ok"].append(ms)
                     if do_put:
-                        put_keys.append(key)
+                        pool.append(key)
                 else:
                     lat_shed.append(ms)
+                    if status == 503:
+                        ts["shed_503"] += 1
                     if r is not None and status >= 400:
                         code = _xml_code(r.body)
                         error_codes[code] = error_codes.get(code, 0) + 1
@@ -212,7 +265,7 @@ def run_load(host: str, port: int, access_key: str, secret_key: str,
     total = sum(status_counts.values())
     ok = len(lat_ok)
     shed = status_counts.get(503, 0)
-    return {
+    report = {
         "requests": total,
         "ok": ok,
         "shed_503": shed,
@@ -234,8 +287,22 @@ def run_load(host: str, port: int, access_key: str, secret_key: str,
         "config": {"concurrency": concurrency, "duration_s": duration,
                    "qps_target": qps, "put_fraction": put_fraction,
                    "object_bytes": object_bytes, "key_space": key_space,
-                   "zipf_s": zipf_s},
+                   "zipf_s": zipf_s, "tenants": n_tenants,
+                   "tenant_zipf_s": tenant_zipf_s},
     }
+    if n_tenants > 1:
+        tenants: dict[str, dict] = {}
+        for i, ts in tstats.items():
+            vals = sorted(ts["lat_ok"])
+            tenants[tenant_label(i)] = {
+                "requests": ts["requests"], "ok": ts["ok"],
+                "shed_503": ts["shed_503"],
+                "latency_ms": {
+                    "p50": round(_percentile(vals, 50), 3),
+                    "p90": round(_percentile(vals, 90), 3),
+                    "p99": round(_percentile(vals, 99), 3)}}
+        report["tenants"] = tenants
+    return report
 
 
 class _LatStats:
@@ -550,6 +617,17 @@ def main() -> int:
     p.add_argument("--zipf", type=float, default=0.0,
                    help="Zipfian key-rank exponent s (>0 enables the "
                         "hot-key mix; try 1.1)")
+    p.add_argument("--buckets", type=int, default=1,
+                   help="multi-tenant fleet: drive N buckets "
+                        "({bucket}-0 .. {bucket}-{N-1}); the report "
+                        "gains per-tenant percentiles")
+    p.add_argument("--access-keys", default="",
+                   help="comma list of ak:sk tenant credentials "
+                        "(created beforehand via admin add-user); "
+                        "default: the root key for every tenant")
+    p.add_argument("--tenant-zipf", type=float, default=0.0,
+                   help="Zipfian skew ACROSS tenants (tenant 0 "
+                        "hottest) — the noisy-neighbor fleet shape")
     p.add_argument("--preload", action="store_true",
                    help="PUT the whole key space before the timed "
                         "window (for pure-GET runs)")
@@ -560,10 +638,16 @@ def main() -> int:
                         "--qps paced across the fleet); reports "
                         "connect/TTFB/total percentiles per class")
     args = p.parse_args()
+    keys = [tuple(item.split(":", 1)) for item in
+            args.access_keys.split(",") if ":" in item]
     if args.make_bucket:
         from minio_tpu.s3.client import S3Client
-        S3Client(args.host, args.port, args.access_key,
-                 args.secret_key).make_bucket(args.bucket)
+        root = S3Client(args.host, args.port, args.access_key,
+                        args.secret_key)
+        names = ([args.bucket] if args.buckets <= 1 else
+                 [f"{args.bucket}-{i}" for i in range(args.buckets)])
+        for name in names:
+            root.make_bucket(name)
     if args.connections > 0:
         report = run_async_load(args.host, args.port, args.access_key,
                                 args.secret_key, args.bucket,
@@ -582,7 +666,9 @@ def main() -> int:
                           put_fraction=args.put_fraction,
                           object_bytes=args.size,
                           key_space=args.key_space, zipf_s=args.zipf,
-                          preload=args.preload)
+                          preload=args.preload, buckets=args.buckets,
+                          access_keys=keys or None,
+                          tenant_zipf_s=args.tenant_zipf)
     print(json.dumps(report, indent=2))
     return 0
 

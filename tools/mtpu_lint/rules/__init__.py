@@ -13,6 +13,7 @@ from .obs import (AutotuneMetricCallRule, DrivemonSlowlogMetricCallRule,
                   KernprofTimelineMetricCallRule, MetricNameRule,
                   NativeAssertRule, PipelineMetricCallRule,
                   QosMetricCallRule, SelectMetricCallRule,
+                  UsageMetricCallRule,
                   WatchdogIncidentMetricCallRule)
 from .resources import ResourceLeakRule
 from .retries import BoundedRetryRule
@@ -39,4 +40,5 @@ def all_rules():
         KernprofTimelineMetricCallRule(),
         WatchdogIncidentMetricCallRule(),
         SelectMetricCallRule(),
+        UsageMetricCallRule(),
     ]

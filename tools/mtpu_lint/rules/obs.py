@@ -14,6 +14,8 @@ O7  obs/watchdog.py + obs/incidents.py recording calls likewise
 O8  ops/autotune.py recording calls likewise (codec_plan_* series)
 O9  s3select/ + ops/select_kernels.py recording calls likewise
     (select_* series)
+O10 obs/usage.py recording calls likewise (usage_* series + the
+    cardinality-guard overflow counter)
 """
 
 from __future__ import annotations
@@ -163,3 +165,11 @@ class SelectMetricCallRule(_LiteralCallRule):
     what = "s3select"
     paths = ("minio_tpu/s3select/",
              "minio_tpu/ops/select_kernels.py")
+
+
+class UsageMetricCallRule(_LiteralCallRule):
+    id = "O10"
+    title = ("usage/sketch metric recordings use literal registered "
+             "names")
+    what = "usage"
+    paths = ("minio_tpu/obs/usage.py",)

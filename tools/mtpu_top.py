@@ -172,6 +172,19 @@ def render(doc: dict, width: int = 60) -> str:
         lines.append(
             f"select: scans/s {_num(last.get('selectRequests', 0) / dt(last))}  "
             f"scan {sp / dt(last) / (1 << 30):.3f} GiB/s")
+    # Attribution row (obs/usage.py census in each sample): the fast
+    # window's top bucket per class with its traffic share — WHO is
+    # the load, next to how much of it there is.  Cluster-merged
+    # samples carry the worst single-node concentration per class.
+    ut = last.get("usageTop") or {}
+    if ut:
+        cells = "  ".join(
+            f"{cls}:{top.get('name', '?')}="
+            f"{top.get('share', 0) * 100:.0f}%"
+            for cls, top in sorted(ut.items()))
+        lines.append(f"tenants: {cells}  (admin /top has the ranks)")
+    else:
+        lines.append("tenants: no attributed traffic in the window")
     d = last.get("drives", {})
     lines.append(f"drives: suspect={d.get('suspect', 0)} "
                  f"faulty={d.get('faulty', 0)} "
