@@ -1949,6 +1949,80 @@ class _DeviceHunt(threading.Thread):
         self._halt.set()
 
 
+# --- config: regen_repair — RS vs REGEN heal repair traffic -----------------
+
+
+def bench_regen_repair(np, workdir: str) -> dict:
+    """Paired RS-vs-REGEN heal of the SAME dataset on a 4+2 layout:
+    identical objects stored under both classes, the same single-disk
+    shard loss inflicted on each, and each class healed separately so
+    the repair-traffic ledger (erasure/regen/repair.REPAIR_BYTES)
+    yields per-mode bytes moved (net + disk) and per-mode heal GiB/s.
+    The headline value is the rs/regen disk-traffic ratio — the
+    repair-by-transfer construction predicts B/d (RS moves ~1 block
+    per repaired block, regen moves d stripe rows of block/B bytes):
+    for 4+2, B=14, d=5, exactly 2.8x.  The ratio is measured on one
+    box so VM drift cancels (host-mode caveat: absolute GiB/s is
+    whatever lane the autotuner picked — trust the paired ratio,
+    which counts bytes, not seconds)."""
+    from minio_tpu.erasure.engine import ErasureObjects
+    from minio_tpu.erasure.regen.repair import REPAIR_BYTES
+    from minio_tpu.storage.metadata import REGEN_ALGORITHM
+    from minio_tpu.storage.xl import XLStorage
+
+    root = os.path.join(workdir, "cfg-regen")
+    n_objects, obj_bytes = 4, 24 * 1024 * 1024  # 96 MiB per class
+    rng = np.random.default_rng(11)
+    try:
+        roots = [os.path.join(root, f"disk{i}") for i in range(6)]
+        disks = [XLStorage(r) for r in roots]
+        eng = ErasureObjects(disks, 4, 2, block_size=1024 * 1024)
+        eng.make_bucket("bench")
+        for i in range(n_objects):
+            body = rng.integers(0, 256, obj_bytes).astype(
+                np.uint8).tobytes()
+            eng.put_object("bench", f"rs-{i}", body)
+            eng.put_object("bench", f"regen-{i}", body,
+                           algorithm=REGEN_ALGORITHM)
+
+        def lose_and_heal(prefix: str) -> tuple[dict, float]:
+            for i in range(n_objects):
+                shutil.rmtree(os.path.join(roots[0], "bench",
+                                           f"{prefix}-{i}"))
+            REPAIR_BYTES.reset()
+            t0 = time.perf_counter()
+            for i in range(n_objects):
+                res = eng.healer.heal_object("bench", f"{prefix}-{i}")
+                if not res.healed_disks:
+                    raise RuntimeError(
+                        f"heal of {prefix}-{i} repaired nothing")
+            dt = time.perf_counter() - t0
+            return REPAIR_BYTES.snapshot(), dt
+
+        rs_bytes, rs_dt = lose_and_heal("rs")
+        regen_bytes, regen_dt = lose_and_heal("regen")
+        total = n_objects * obj_bytes
+        ratio_disk = rs_bytes["rs"]["disk"] / regen_bytes["regen"]["disk"]
+        ratio_net = rs_bytes["rs"]["net"] / regen_bytes["regen"]["net"]
+        if min(ratio_disk, ratio_net) < 2.0:
+            raise RuntimeError(
+                f"regen repair reduction below 2x (disk {ratio_disk:.2f}, "
+                f"net {ratio_net:.2f})")
+        return {"metric": "regen_repair", "layout": "4+2",
+                "value": round(ratio_disk, 3), "unit": "x_less_disk",
+                "repair_bytes": {"rs": rs_bytes["rs"],
+                                 "regen": regen_bytes["regen"]},
+                "ratio_net": round(ratio_net, 3),
+                "rs_heal_gibps": round(total / rs_dt / (1 << 30), 3),
+                "regen_heal_gibps": round(
+                    total / regen_dt / (1 << 30), 3),
+                "total_bytes_per_class": total,
+                "note": "ratio counts bytes (drift-free); GiB/s is "
+                        "host-lane dependent"}
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def main() -> None:
     import numpy as np
 
@@ -2043,7 +2117,9 @@ def main() -> None:
                      ("crash_recovery",
                       lambda: bench_crash_recovery(np, workdir)),
                      ("select_scan",
-                      lambda: bench_select_scan(np, workdir))):
+                      lambda: bench_select_scan(np, workdir)),
+                     ("regen_repair",
+                      lambda: bench_regen_repair(np, workdir))):
         _progress(f"config {name} (host mode)")
         pipe = config_pipeline.get(name)
         factor_box: dict = {}

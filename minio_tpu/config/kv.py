@@ -61,6 +61,9 @@ DEFAULT_KVS: dict[str, dict[str, str]] = {
     "storage_class": {
         "standard": "",
         "rrs": "EC:2",
+        # Comma-separated buckets whose PUTs default to the REGEN
+        # (regenerating-code) class; live-reloadable.
+        "regen_buckets": "",
     },
     "region": {
         "name": "us-east-1",

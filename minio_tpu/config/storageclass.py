@@ -14,6 +14,11 @@ from dataclasses import dataclass
 
 STANDARD = "STANDARD"
 RRS = "REDUCED_REDUNDANCY"
+# Regenerating-code class (this repo's extension): same k+m durability
+# as STANDARD but objects are coded with the repair-by-transfer
+# product-matrix MBR code (ops/rs_regen.py) — single-shard repair moves
+# a fraction of the traffic, at a higher raw-storage overhead.
+REGEN = "REGEN"
 
 # Stored in object metadata when the class is non-default (ref
 # xhttp.AmzStorageClass handling in putObject).
@@ -38,11 +43,21 @@ def _parse_ec(v: str) -> int | None:
         raise InvalidStorageClass(f"malformed storage class value {v!r}")
 
 
+def _parse_buckets(v: str) -> frozenset[str]:
+    """Parse the comma-separated regen_buckets list (whitespace
+    tolerated, empty entries dropped)."""
+    return frozenset(b.strip() for b in (v or "").split(",")
+                     if b.strip())
+
+
 @dataclass
 class StorageClassConfig:
     """Parity-per-class table for one erasure set size."""
     standard_parity: int | None = None  # None = set default (n/2)
     rrs_parity: int | None = None
+    # Buckets whose PUTs default to the REGEN class without a header
+    # (config-KV `storage_class regen_buckets=a,b`, live-reloadable).
+    regen_buckets: frozenset[str] = frozenset()
 
     @classmethod
     def from_env(cls, env=os.environ) -> "StorageClassConfig":
@@ -50,6 +65,8 @@ class StorageClassConfig:
             standard_parity=_parse_ec(
                 env.get("MINIO_STORAGE_CLASS_STANDARD", "")),
             rrs_parity=_parse_ec(env.get("MINIO_STORAGE_CLASS_RRS", "")),
+            regen_buckets=_parse_buckets(
+                env.get("MINIO_STORAGE_CLASS_REGEN_BUCKETS", "")),
         )
 
     def parity_for(self, storage_class: str, n_disks: int,
@@ -58,7 +75,9 @@ class StorageClassConfig:
         Raises InvalidStorageClass for unknown classes or a parity that
         the set geometry cannot hold (need 0 < m <= n/2)."""
         sc = storage_class or STANDARD
-        if sc == STANDARD:
+        if sc in (STANDARD, REGEN):
+            # REGEN keeps STANDARD's parity: equal k+m durability, the
+            # repair math is what differs (erasure/regen/).
             m = (set_default if self.standard_parity is None
                  else self.standard_parity)
         elif sc == RRS:
@@ -70,3 +89,11 @@ class StorageClassConfig:
             raise InvalidStorageClass(
                 f"parity {m} invalid for {n_disks}-disk set")
         return m
+
+    def use_regen(self, storage_class: str, bucket: str) -> bool:
+        """Should this PUT store under the REGEN class? Per-request
+        header wins; otherwise the bucket's config-KV default applies
+        (an explicit STANDARD/RRS header opts a single PUT back out)."""
+        if storage_class:
+            return storage_class == REGEN
+        return bucket in self.regen_buckets

@@ -62,6 +62,12 @@ class Erasure:
         """Per-shard size of a full block (ref cmd/erasure-coding.go:115)."""
         return ceil_frac(self.block_size, self.data_blocks)
 
+    def chunk_size(self, block_len: int) -> int:
+        """Per-shard stored bytes for a block of block_len bytes (the
+        codec-agnostic form the read/heal paths size their frames with
+        — RegenErasure's differs from this k-way split)."""
+        return ceil_frac(block_len, self.data_blocks)
+
     def shard_file_size(self, total_length: int) -> int:
         """On-disk per-shard data size for an object of total_length bytes
         (ref cmd/erasure-coding.go:120)."""
@@ -225,3 +231,22 @@ class Erasure:
             want_all=True, use_device=self._use_tpu_decode,
             device_fallback=self.backend != "tpu",
             affinity=self.affinity)
+
+
+def codec_for_algorithm(algorithm: str | None, data_blocks: int,
+                        parity_blocks: int,
+                        block_size: int = BLOCK_SIZE,
+                        backend: str = "auto",
+                        affinity: int | None = None):
+    """The codec for an xl.meta erasure algorithm stamp: plain RS
+    (`rs-vandermonde`, the default and the value every pre-REGEN object
+    carries) or the regenerating-code class (`pm-mbr-rbt`).  Lazy
+    imports keep codec.py free of the regen subsystem for the common
+    path and avoid the metadata<->ops cycle."""
+    from ..storage.metadata import REGEN_ALGORITHM
+    if algorithm == REGEN_ALGORITHM:
+        from .regen import RegenErasure
+        return RegenErasure(data_blocks, parity_blocks, block_size,
+                            backend=backend, affinity=affinity)
+    return Erasure(data_blocks, parity_blocks, block_size,
+                   backend=backend, affinity=affinity)

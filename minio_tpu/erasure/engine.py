@@ -31,8 +31,9 @@ from ..parallel.quorum import (MULTICORE, QuorumError, hash_order,
                                reduce_quorum_errs, submit, write_quorum)
 from ..storage import errors as serr
 from ..storage.interface import StorageAPI
-from ..storage.metadata import (ErasureInfo, FileInfo, ObjectPartInfo,
-                                new_data_dir, new_version_id, now)
+from ..storage.metadata import (ERASURE_ALGORITHM, ErasureInfo, FileInfo,
+                                ObjectPartInfo, new_data_dir,
+                                new_version_id, now)
 from ..storage.xl import INTENT_FILE, MINIO_META_BUCKET, TMP_PATH
 from ..utils import ceil_frac
 from . import bitrot
@@ -473,28 +474,36 @@ class ErasureObjects:
     # ------------------------------------------------------------------
     # write path
 
-    def codec_for(self, k: int, m: int, block_size: int | None = None):
+    def codec_for(self, k: int, m: int, block_size: int | None = None,
+                  algorithm: str | None = None):
         """Codec for a per-object geometry (storage class may override
         the set default parity, ref GetParityForSC,
         cmd/config/storageclass/storage-class.go; old objects may also
-        carry a different block size)."""
+        carry a different block size) and erasure algorithm (the REGEN
+        storage class stamps pm-mbr-rbt in xl.meta; absent/rs means
+        plain RS, so every pre-REGEN object resolves unchanged)."""
+        algo = algorithm or ERASURE_ALGORITHM
         bs = self.block_size if block_size is None else block_size
-        if (k, m, bs) == (self.k, self.m, self.block_size):
+        if (k, m, bs, algo) == (self.k, self.m, self.block_size,
+                                ERASURE_ALGORITHM):
             return self.codec
-        key = (k, m, bs)
+        key = (k, m, bs, algo)
         codec = self._codec_cache.get(key)
         if codec is None:
-            codec = Erasure(k, m, bs)
-            # Per-object geometries still dispatch from THIS set: they
-            # share its home device.
-            codec.affinity = getattr(self, "device_affinity", None)
+            from .codec import codec_for_algorithm
+            codec = codec_for_algorithm(
+                algo, k, m, bs,
+                # Per-object geometries still dispatch from THIS set:
+                # they share its home device.
+                affinity=getattr(self, "device_affinity", None))
             self._codec_cache[key] = codec
         return codec
 
     def put_object(self, bucket: str, object_name: str, data,
                    metadata: dict | None = None,
                    versioned: bool = False,
-                   parity_shards: int | None = None) -> ObjectInfo:
+                   parity_shards: int | None = None,
+                   algorithm: str | None = None) -> ObjectInfo:
         """Streaming block pipeline (ref Erasure.Encode block loop,
         cmd/erasure-encode.go:73-109 + parallelWriter :36-70): `data` is
         bytes OR a chunk reader/iterable. The stream is consumed in
@@ -509,7 +518,7 @@ class ErasureObjects:
         if not (0 < m <= n // 2):
             raise ValueError(f"parity {m} out of range for {n} disks")
         k = n - m
-        codec = self.codec_for(k, m)
+        codec = self.codec_for(k, m, algorithm=algorithm)
         distribution = hash_order(f"{bucket}/{object_name}", n)
         wq = write_quorum(k, m)
         reader = streams.ensure_reader(data)
@@ -636,6 +645,7 @@ class ErasureObjects:
                     size=total, mod_time=mod_time, metadata=meta,
                     parts=[part],
                     erasure=ErasureInfo(
+                        algorithm=algorithm or ERASURE_ALGORITHM,
                         data_blocks=k, parity_blocks=m,
                         block_size=self.block_size,
                         index=distribution[i],
@@ -836,6 +846,28 @@ class ErasureObjects:
         if len(data) == 0:
             return None, None
         from ..obs.span import TRACER
+        if getattr(codec, "is_regen", False):
+            # REGEN encode: no k-way pre-split — the product-matrix
+            # code consumes raw block bytes (pack_blocks_batch stripes
+            # them B-wide) and emits n equal non-systematic chunks.
+            # Same (full_sm, tails) contract, so framing and the
+            # writer fan-out are untouched.
+            with TRACER.span("kernel.regen_encode", bytes=len(data),
+                             k=k, m=m):
+                full_sm = None
+                nfull = len(data) // self.block_size
+                if nfull:
+                    full = np.frombuffer(
+                        data[:nfull * self.block_size], dtype=np.uint8,
+                    ).reshape(nfull, self.block_size)
+                    full_sm = codec.encode_blocks_batch_bytes(full)
+                rest = data[nfull * self.block_size:]
+                tails = None
+                if rest:
+                    shards = codec.encode_data(rest)
+                    tails = [shards[j].tobytes()
+                             for j in range(codec.total_shards)]
+                return full_sm, tails
         with TRACER.span("kernel.rs_encode", bytes=len(data),
                          k=k, m=m):
             shard_size = codec.shard_size()
@@ -1336,9 +1368,12 @@ class ErasureObjects:
         k, m = fi.erasure.data_blocks, fi.erasure.parity_blocks
         shard_size = fi.erasure.shard_size()
         by_shard = self._shard_readers(fi, agreed)
-        # Codec geometry comes from the object's metadata (it may differ
-        # from this engine's default).
-        codec = self.codec_for(k, m, fi.erasure.block_size)
+        # Codec geometry AND algorithm come from the object's metadata
+        # (they may differ from this engine's default — mixed-class
+        # buckets hold RS and REGEN objects side by side).
+        codec = self.codec_for(k, m, fi.erasure.block_size,
+                               algorithm=fi.erasure.algorithm)
+        is_regen = getattr(codec, "is_regen", False)
 
         # Block coverage of [offset, offset+length).
         start_block = offset // fi.erasure.block_size
@@ -1459,7 +1494,7 @@ class ErasureObjects:
             for b in range(g0, g1 + 1):
                 blk_len = (min(fi.erasure.block_size,
                                part_size - b * fi.erasure.block_size))
-                metas.append((b, blk_len, ceil_frac(blk_len, k)))
+                metas.append((b, blk_len, codec.chunk_size(blk_len)))
 
             frame_ok: dict[tuple[int, int], np.ndarray] = {}
             verified: set[int] = set()
@@ -1542,6 +1577,25 @@ class ErasureObjects:
                     raise QuorumError(
                         f"block {b}: only {good}/{k} shards valid", [])
                 gathered.append((b, blk_len, shards))
+
+            if is_regen:
+                # REGEN is non-systematic: EVERY read decodes the
+                # message stripes from its k verified chunks — one
+                # batched dispatch per (mask, stripe-count) group.
+                with TRACER.span("kernel.regen_decode",
+                                 parent=_read_parent,
+                                 blocks=len(gathered)):
+                    texts = codec.decode_blocks_batch(
+                        [sh for _b, _bl, sh in gathered],
+                        [bl for _b, bl, _sh in gathered])
+                for (b, blk_len, _sh), block_data in zip(gathered,
+                                                         texts):
+                    bstart = b * fi.erasure.block_size
+                    lo = max(offset, bstart) - bstart
+                    hi = min(want_end, bstart + blk_len) - bstart
+                    if hi > lo:
+                        yield block_data[lo:hi]
+                return
 
             # Pass 2: batch-reconstruct blocks with data loss — blocks
             # of one object share an erasure mask, so the whole group is

@@ -32,7 +32,7 @@ from ..storage.metadata import FileInfo
 from ..storage.xl import INTENT_FILE, MINIO_META_BUCKET, TMP_PATH
 from ..utils import ceil_frac
 from . import bitrot
-from .codec import Erasure
+from .codec import codec_for_algorithm
 
 # Cap on stacked survivor bytes per coalesced heal dispatch: large
 # enough to saturate the device, small enough to bound heal memory.
@@ -252,10 +252,14 @@ class Healer:
         # production and the queue drains (defer = drain, don't grow).
         shard_size = fi.erasure.shard_size()
         missing_shards = sorted(shard_of_disk[i] for i in bad)
-        codec = Erasure(k, m, fi.erasure.block_size)
-        # Heal reconstructs dispatch from this set too: same home
-        # device as the serving codec (parallel/mesh.py affinity).
-        codec.affinity = getattr(self.engine, "device_affinity", None)
+        # Codec follows the object's xl.meta algorithm stamp: REGEN
+        # objects heal through the minimum-bandwidth regen path below,
+        # plain-RS objects through the conventional k-survivor decode.
+        codec = codec_for_algorithm(
+            fi.erasure.algorithm, k, m, fi.erasure.block_size,
+            # Heal reconstructs dispatch from this set too: same home
+            # device as the serving codec (parallel/mesh.py affinity).
+            affinity=getattr(self.engine, "device_affinity", None))
         from ..storage.metadata import ObjectPartInfo
         parts = fi.parts or [ObjectPartInfo(number=1, size=fi.size,
                                             actual_size=fi.size)]
@@ -267,25 +271,28 @@ class Healer:
                     algo = cs.get("algorithm", algo)
             return algo
 
+        # Health-ranked survivors (obs/drivemon.py): read the k shards
+        # (or, for REGEN, contact the d helpers) from the healthiest
+        # sources first — a suspect drive only serves a heal read when
+        # no healthier survivor can (the same any-k-of-n policy the GET
+        # path uses).
+        from ..obs.drivemon import DRIVEMON, OK as _DM_OK
+
+        def _rank(i: int) -> tuple:
+            ep = eng.endpoints[i]
+            state = DRIVEMON.state_of(ep)
+            return (1 if DRIVEMON.is_quarantined(ep) else 0,
+                    0 if state == _DM_OK else 1,
+                    DRIVEMON.ewma_for(ep).get("read", 0.0))
+
+        read_order = sorted(good_disks, key=_rank)
+        from .regen.repair import REPAIR_BYTES
+
         def produce_groups():
             """Yield (part_number, {shard_idx: framed bytes}) per block
             group, parts in order, groups in order — consecutive
             groups' frames concatenate into exactly the shard stream
             the old whole-part encode produced."""
-            # Health-ranked survivors (obs/drivemon.py): read the k
-            # shards from the healthiest sources first — a suspect
-            # drive only serves a heal read when no healthier survivor
-            # can (the same any-k-of-n policy the GET path uses).
-            from ..obs.drivemon import DRIVEMON, OK as _DM_OK
-
-            def _rank(i: int) -> tuple:
-                ep = eng.endpoints[i]
-                state = DRIVEMON.state_of(ep)
-                return (1 if DRIVEMON.is_quarantined(ep) else 0,
-                        0 if state == _DM_OK else 1,
-                        DRIVEMON.ewma_for(ep).get("read", 0.0))
-
-            read_order = sorted(good_disks, key=_rank)
             for part in parts:
                 # Collect k survivor streams, tolerating read failures
                 # from disks that were "ok" at classify time but
@@ -296,13 +303,19 @@ class Healer:
                     if len(streams) == k:
                         break
                     try:
-                        streams[shard_of_disk[i]] = \
-                            eng.disks[i].read_all(
-                                bucket,
-                                f"{object_name}/{fi.data_dir}"
-                                f"/part.{part.number}")
+                        data = eng.disks[i].read_all(
+                            bucket,
+                            f"{object_name}/{fi.data_dir}"
+                            f"/part.{part.number}")
                     except serr.StorageError:
                         continue
+                    # Repair-traffic ledger (the RS baseline the regen
+                    # path's 2x claim is measured against): a full
+                    # survivor chunk is read from media AND crosses the
+                    # wire in a distributed set.
+                    REPAIR_BYTES.add("rs", "disk", len(data))
+                    REPAIR_BYTES.add("rs", "net", len(data))
+                    streams[shard_of_disk[i]] = data
                 if len(streams) < k:
                     raise serr.FaultyDisk(
                         f"heal {bucket}/{object_name}: only "
@@ -387,11 +400,22 @@ class Healer:
             max(1, ceil_frac(ceil_frac(p.size, fi.erasure.block_size),
                              group_blocks))
             for p in parts)
+        if getattr(codec, "is_regen", False):
+            # Minimum-bandwidth REGEN heal: helpers project locally and
+            # ship d small rows per block instead of k full chunks
+            # (erasure/regen/repair.py); the generator feeds the SAME
+            # write-back pipeline, crash points and commit below.
+            from .regen.repair import regen_heal_groups
+            producer = regen_heal_groups(
+                eng, bucket, object_name, fi, codec, parts,
+                missing_shards, shard_of_disk, read_order, part_algo,
+                HEAL_BATCH_BYTES)
+        else:
+            producer = produce_groups()
         from ..utils.pipeline import Prefetch
-        pf = (Prefetch(produce_groups(), depth=eng.pipeline_depth,
-                       name="heal")
+        pf = (Prefetch(producer, depth=eng.pipeline_depth, name="heal")
               if n_groups > 1 else
-              contextlib.nullcontext(produce_groups()))
+              contextlib.nullcontext(producer))
         with pf as groups:
             try:
                 for part_number, frames in groups:

@@ -90,12 +90,14 @@ class ErasureServerPools:
     def put_object(self, bucket: str, object_name: str, data,
                    metadata: dict | None = None,
                    versioned: bool = False,
-                   parity_shards: int | None = None) -> ObjectInfo:
+                   parity_shards: int | None = None,
+                   algorithm: str | None = None) -> ObjectInfo:
         idx = self._put_pool_index(bucket, object_name)
         return self.pools[idx].put_object(bucket, object_name, data,
                                           metadata=metadata,
                                           versioned=versioned,
-                                          parity_shards=parity_shards)
+                                          parity_shards=parity_shards,
+                                          algorithm=algorithm)
 
     def _probe(self, bucket: str, object_name: str, op):
         """Try each pool in order; first hit wins (ref pool probe loop,

@@ -1,0 +1,12 @@
+"""Regenerating-code storage class (REGEN): minimum-bandwidth repair.
+
+The codec (`RegenErasure`) mirrors the `Erasure` seams the engine
+consumes (shard sizes, batched encode, batched whole-block decode) over
+the repair-by-transfer product-matrix MBR construction in
+ops/rs_regen.py; `repair` holds the heal-side collector that rebuilds a
+lost shard from one stored stripe symbol per helper (the
+`repair_project` storage RPC) instead of k full shard reads.
+"""
+
+from .codec import RegenErasure  # noqa: F401
+from .repair import regen_heal_groups  # noqa: F401

@@ -98,10 +98,12 @@ class ErasureSets:
     def put_object(self, bucket: str, object_name: str, data: bytes,
                    metadata: dict | None = None,
                    versioned: bool = False,
-                   parity_shards: int | None = None) -> ObjectInfo:
+                   parity_shards: int | None = None,
+                   algorithm: str | None = None) -> ObjectInfo:
         return self.set_for(object_name).put_object(
             bucket, object_name, data, metadata=metadata,
-            versioned=versioned, parity_shards=parity_shards)
+            versioned=versioned, parity_shards=parity_shards,
+            algorithm=algorithm)
 
     def get_object(self, bucket: str, object_name: str, offset: int = 0,
                    length: int = -1, version_id: str = ""):

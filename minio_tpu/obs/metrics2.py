@@ -464,6 +464,13 @@ METRICS2.register(
     "minio_tpu_v2_mrf_queue_depth", "gauge",
     "Objects waiting in the most-recently-failed heal queue.")
 METRICS2.register(
+    "minio_tpu_v2_heal_repair_bytes_total", "counter",
+    "Repair traffic moved by object heals, by mode (rs = conventional "
+    "k-survivor decode, regen = minimum-bandwidth REGEN repair) and "
+    "src (disk = bytes helpers read from media, net = bytes shipped "
+    "in helper responses) — the observable form of the regenerating "
+    "code's repair-bandwidth claim.")
+METRICS2.register(
     "minio_tpu_v2_fault_injections_total", "counter",
     "Faults injected by the runtime fault-injection subsystem, "
     "by kind.")

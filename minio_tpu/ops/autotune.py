@@ -61,7 +61,9 @@ from ..obs.kernprof import BACKENDS, DEVICE, HOST, NATIVE, XLA_CPU
 RS_ENCODE = "rs_encode"
 RS_DECODE = "rs_decode"
 SELECT_SCAN = "select_scan"
-KERNELS = (RS_ENCODE, RS_DECODE, SELECT_SCAN)
+# Regenerating-code (REGEN storage class) GF apply — ops/rs_regen.py.
+REGEN_CODE = "regen_code"
+KERNELS = (RS_ENCODE, RS_DECODE, SELECT_SCAN, REGEN_CODE)
 # The RS probe ladder seeds only the codec kernels — select scans get
 # their OWN known-answer probe (ops/select_kernels.probe_lane): GF
 # table-gather throughput says nothing about predicate-mask math.
@@ -159,6 +161,7 @@ class CodecAutotuner:
         self._probe_thread: threading.Thread | None = None
         self._last_probe: dict[str, dict] = {}
         self._last_select_probe: dict[str, dict] = {}
+        self._last_regen_probe: dict[str, dict] = {}
         # Transition fan-out, kernprof-style: decided under _mu,
         # published FIFO under _announce_mu so two threads replanning
         # back-to-back can't publish the sinks in swapped order.
@@ -365,6 +368,7 @@ class CodecAutotuner:
                         self._feed_locked(kern, TOP_BUCKET, lane,
                                           top * (1 << 30))
         self._probe_select_lanes()
+        self._probe_regen_lanes()
         with self._mu:
             self._last_probe = results
             for kern in KERNELS:
@@ -439,6 +443,52 @@ class CodecAutotuner:
                                       top * (1 << 30))
         with self._mu:
             self._last_select_probe = results
+
+    def _probe_regen_lanes(self) -> None:
+        """Known-answer regenerating-code probes per size rung: the jit
+        lane (device when one answers, xla-cpu otherwise) and the numpy
+        host lane — seeding the (regen_code, bucket, lane) model so the
+        REGEN codec's dispatch is measured, never hardwired.  RS probe
+        numbers don't transfer: the regen apply is a (B, ·) stripe
+        matmul with B = kd - k(k-1)/2 rows, a different shape family
+        from the k-row RS apply."""
+        from .rs_regen import probe_lane
+        jit_lane = DEVICE if self._device_visible() else XLA_CPU
+        results: dict[str, dict] = {}
+        for lane in (jit_lane, HOST):
+            results[lane] = {}
+            for bucket, _B, _S in _PROBE_RUNGS:
+                nbytes = _B * _PROBE_K * _S
+                # probe geometry is 4+2 (B = 14 stripe rows)
+                nstripes = max(4096, nbytes // 14)
+                bps, err = probe_lane(lane, nstripes)
+                from ..obs.metrics2 import METRICS2
+                from ..logger import Logger
+                METRICS2.inc("minio_tpu_v2_codec_plan_probes_total",
+                             {"lane": lane,
+                              "result": "pass" if bps else "fail"})
+                if bps:
+                    with self._mu:
+                        self._feed_locked(REGEN_CODE, bucket, lane,
+                                          bps)
+                    Logger.get().info(
+                        f"autotune: probe regen/{lane}[{bucket}] "
+                        f"{bps / (1 << 30):.3f} GiB/s", "autotune",
+                        lane=lane, bucket=bucket)
+                else:
+                    Logger.get().info(
+                        f"autotune: probe regen/{lane}[{bucket}] "
+                        f"failed ({err})", "autotune", lane=lane,
+                        bucket=bucket)
+                results[lane][bucket] = (
+                    round(bps / (1 << 30), 6) if bps else None)
+            top = results[lane].get("4-16M")
+            if top:
+                with self._mu:
+                    self._feed_locked(REGEN_CODE, TOP_BUCKET, lane,
+                                      top * (1 << 30))
+        with self._mu:
+            self._last_regen_probe = results
 
     @staticmethod
     def _device_visible() -> bool:
@@ -681,6 +731,7 @@ class CodecAutotuner:
                 "crossover": crossover,
                 "lastProbe": self._last_probe,
                 "lastSelectProbe": self._last_select_probe,
+                "lastRegenProbe": self._last_regen_probe,
             }
         out["backendStates"] = {
             b: KERNPROF.state_of(b) for b in BACKENDS}
@@ -694,6 +745,7 @@ class CodecAutotuner:
             self._probed = False
             self._last_probe = {}
             self._last_select_probe = {}
+            self._last_regen_probe = {}
             self._pending.clear()
         self.enabled = True
         self.hysteresis = self.HYSTERESIS

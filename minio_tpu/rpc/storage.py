@@ -76,6 +76,11 @@ class StorageRPCService:
         return {}, self._disk(a).read_file(a["volume"], a["path"],
                                            a["offset"], a["length"])
 
+    def rpc_repair_project(self, a, p):
+        return {}, self._disk(a).repair_project(
+            a["volume"], a["path"],
+            [(int(o), int(ln)) for o, ln in a["ranges"]])
+
     def rpc_create_file(self, a, p):
         self._disk(a).create_file(a["volume"], a["path"], p)
         return {}, b""
@@ -258,6 +263,17 @@ class RemoteStorage(StorageAPI):
                                         "length": length})[1]
         from ..faultinject import FAULTS
         return FAULTS.filter_read(self._drive_key(), "read_file", data)
+
+    def repair_project(self, volume, path, ranges):
+        # The whole point of REGEN repair: ONE round trip carrying only
+        # the projection bytes (d stored rows per group), not a ranged
+        # read per row and never the helper's full chunk.
+        data = self._call("repair_project",
+                          {"volume": volume, "path": path,
+                           "ranges": [[o, ln] for o, ln in ranges]})[1]
+        from ..faultinject import FAULTS
+        return FAULTS.filter_read(self._drive_key(), "repair_project",
+                                  data)
 
     def create_file(self, volume, path, data):
         if isinstance(data, (bytes, bytearray, memoryview)):

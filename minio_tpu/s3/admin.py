@@ -743,8 +743,13 @@ class AdminHandlers:
                     mrf = getattr(es, "mrf", None)
                     if mrf is not None and hasattr(mrf, "journal"):
                         journals.append(mrf.journal.stats())
+        # Heal repair-traffic ledger: bytes moved per repair mode
+        # (rs vs regen) and source (disk vs net) since boot — the
+        # paired counters behind the REGEN class's bandwidth claim.
+        from ..erasure.regen.repair import REPAIR_BYTES
         return {"sweeps": getattr(self.server, "recovery_reports", []),
-                "journals": journals}
+                "journals": journals,
+                "repair": REPAIR_BYTES.snapshot()}
 
     # -- runtime fault injection (minio_tpu/faultinject) ---------------
 

@@ -207,9 +207,9 @@ ERR_OBJECT_CORRUPT = _e(
 # imports nothing, so this import cannot cycle.)
 from ..storage.errors import (DiskFull, DiskNotFound,  # noqa: E402
                               DriveQuarantined, FaultyDisk, FileCorrupt,
-                              FileNotFound, StorageError,
-                              VersionNotFound, VolumeExists,
-                              VolumeNotFound)
+                              FileNotFound, RegenRepairFailed,
+                              StorageError, VersionNotFound,
+                              VolumeExists, VolumeNotFound)
 
 STORAGE_ERROR_MAP = {
     StorageError: ERR_INTERNAL_ERROR,
@@ -224,6 +224,9 @@ STORAGE_ERROR_MAP = {
     # A quarantine marker surfacing alone means the engine could not
     # find enough healthy drives either — retryable unavailability.
     DriveQuarantined: ERR_SLOW_DOWN,
+    # A failed REGEN repair is a transient helper shortfall, not data
+    # loss: the object still decodes from any k nodes.
+    RegenRepairFailed: ERR_SLOW_DOWN,
 }
 
 

@@ -231,6 +231,8 @@ class S3ApiHandlers:
         sc_hdr = req.headers.get("x-amz-storage-class", "")
         n = getattr(self.layer, "k", 0) + getattr(self.layer, "m", 0)
         if n < 2:
+            # FS layer: REGEN needs erasure shards, so it is invalid
+            # here just like any unknown class.
             if sc_hdr and sc_hdr not in (sc.STANDARD, sc.RRS):
                 raise s3err.ERR_INVALID_STORAGE_CLASS
             return None
@@ -239,6 +241,22 @@ class S3ApiHandlers:
                 sc_hdr, n, getattr(self.layer, "m", 0))
         except sc.InvalidStorageClass:
             raise s3err.ERR_INVALID_STORAGE_CLASS
+
+    def _regen_algorithm_for_request(self, req: S3Request) -> str | None:
+        """The erasure algorithm stamp for this PUT: pm-mbr-rbt when
+        the REGEN class applies (per-request header or the bucket's
+        regen_buckets config default), None otherwise.  Only erasure
+        layers qualify; multipart uploads stay plain-RS (the part
+        pipeline re-splits on byte boundaries the regen stripe layout
+        does not honor)."""
+        n = getattr(self.layer, "k", 0) + getattr(self.layer, "m", 0)
+        if n < 2:
+            return None
+        sc_hdr = req.headers.get("x-amz-storage-class", "")
+        if self.storage_class.use_regen(sc_hdr, req.bucket):
+            from ..storage.metadata import REGEN_ALGORITHM
+            return REGEN_ALGORITHM
+        return None
 
     # A full listing re-baselines a bucket's usage counter at most
     # this often; between reconciles the counter moves incrementally
@@ -921,6 +939,7 @@ class S3ApiHandlers:
             meta["x-amz-tagging"] = req.headers["x-amz-tagging"]
         self._apply_lock_headers(req, meta)
         parity = self._parity_for_request(req)
+        algorithm = self._regen_algorithm_for_request(req)
         if req.headers.get("x-amz-storage-class"):
             meta["x-amz-storage-class"] = req.headers[
                 "x-amz-storage-class"]
@@ -948,10 +967,14 @@ class S3ApiHandlers:
                     (time.perf_counter() - _t_start) * 1e3)
         _t_layer = time.perf_counter()
         try:
+            # algorithm only reaches erasure layers (the FS layer's
+            # put_object has no such seam, and _regen_algorithm_for_
+            # request answers None there).
+            extra = {"algorithm": algorithm} if algorithm else {}
             info = self.layer.put_object(
                 req.bucket, req.key, body, metadata=meta,
                 versioned=versioned,
-                parity_shards=parity)
+                parity_shards=parity, **extra)
         except streams.ChecksumError as e:
             if "MD5" in str(e):
                 raise s3err.ERR_BAD_DIGEST
@@ -2222,9 +2245,15 @@ class S3Server:
         """Reject values that would break the running system BEFORE
         they persist (ref per-subsystem validation in lookupConfigs)."""
         if subsys == "storage_class":
-            from ..config.storageclass import _parse_ec
+            from ..config.storageclass import _parse_buckets, _parse_ec
             n = getattr(self.layer, "k", 0) + getattr(self.layer, "m", 0)
             for key, v in kvs.items():
+                if key == "regen_buckets":
+                    # A bucket list, not an EC:m value; any parse
+                    # result is safe (unknown buckets simply never
+                    # match a PUT).
+                    _parse_buckets(v)
+                    continue
                 try:
                     m = _parse_ec(v)
                 except Exception as e:
@@ -2479,7 +2508,8 @@ class S3Server:
     def _apply_config(self, cfg) -> None:
         """Push dynamic config into the running subsystems (the
         reference's dynamic-subsystem reload on SetKVS)."""
-        from ..config.storageclass import StorageClassConfig, _parse_ec
+        from ..config.storageclass import (StorageClassConfig,
+                                           _parse_buckets, _parse_ec)
         from ..logger.audit import AuditWebhook
         h = self.handlers
         if h is None:
@@ -2494,7 +2524,9 @@ class S3Server:
             h.storage_class = StorageClassConfig(
                 standard_parity=_parse_ec(
                     cfg.get("storage_class", "standard")),
-                rrs_parity=_parse_ec(cfg.get("storage_class", "rrs")))
+                rrs_parity=_parse_ec(cfg.get("storage_class", "rrs")),
+                regen_buckets=_parse_buckets(
+                    cfg.get("storage_class", "regen_buckets")))
         except Exception as e:  # env override may carry garbage
             from ..logger import Logger
             Logger.get().log_once(

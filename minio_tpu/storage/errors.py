@@ -33,6 +33,13 @@ class FileCorrupt(StorageError):
     """File failed bitrot/format validation (ref errFileCorrupt)."""
 
 
+class RegenRepairFailed(StorageError):
+    """Regenerating-code (REGEN) repair could not complete: the
+    minimum-bandwidth helper collection fell short AND the conventional
+    any-k fallback had fewer than k readable chunks.  Retryable — a
+    flapping helper may answer the next heal pass."""
+
+
 class DiskFull(StorageError):
     """No space left (ref errDiskFull)."""
 

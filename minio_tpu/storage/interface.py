@@ -68,6 +68,19 @@ class StorageAPI(abc.ABC):
                   length: int) -> bytes:
         """Ranged read (ref ReadFileStream)."""
 
+    def repair_project(self, volume: str, path: str,
+                       ranges: list[tuple[int, int]]) -> bytes:
+        """Minimum-bandwidth repair read (REGEN storage class): the
+        concatenated bytes of [offset, offset+length) slices — one
+        stored stripe row per block of a heal group
+        (erasure/regen/repair.py computes the offsets).  The default
+        composes ranged reads, so every local disk and test stub
+        supports it; rpc.RemoteStorage overrides it with a SINGLE RPC
+        so only the small projection crosses the wire — the whole
+        point of the regenerating code."""
+        return b"".join(self.read_file(volume, path, off, length)
+                        for off, length in ranges)
+
     @abc.abstractmethod
     def create_file(self, volume: str, path: str, data) -> None:
         """Write a (shard) file, creating parents (ref CreateFile,

@@ -23,6 +23,9 @@ XL_META_FORMAT = "xl-tpu/1"
 XL_META_FILE = "xl.meta"
 
 ERASURE_ALGORITHM = "rs-vandermonde"  # ref erasureAlgorithm "ReedSolomon"
+# Regenerating code (REGEN storage class): repair-by-transfer
+# product-matrix MBR (ops/rs_regen.py / erasure/regen/).
+REGEN_ALGORITHM = "pm-mbr-rbt"
 
 
 @dataclass
@@ -60,6 +63,13 @@ class ErasureInfo:
                    checksums=list(d.get("checksums", [])))
 
     def shard_size(self) -> int:
+        if self.algorithm == REGEN_ALGORITHM:
+            # Regen nodes store alpha=d stripe rows of ceil(block/B)
+            # bytes each — a different size family from RS's
+            # ceil(block/k) (ops/rs_regen.py geometry).
+            from ..ops.rs_regen import geometry
+            g = geometry(self.data_blocks, self.parity_blocks)
+            return g.d * (-(-self.block_size // g.B))
         return -(-self.block_size // self.data_blocks)
 
 

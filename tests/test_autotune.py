@@ -98,11 +98,11 @@ def test_probe_ladder_measures_and_plans(ladder_results):
     if all(v for v in res.get(NATIVE, {}).values()):
         assert res[NATIVE]["1-4M"] > res[XLA_CPU]["1-4M"]
     # Full plan coverage, every bucket on a measured healthy lane.
-    # Codec kernels fully covered; the select kernel's OWN probe
-    # ladder (ops/select_kernels.probe_lane) covers its buckets too.
+    # Codec kernels fully covered; select_scan and regen_code run
+    # their OWN known-answer probes, covering their buckets too.
     assert set(plan) == {(k, b)
                          for k in (RS_ENCODE, RS_DECODE,
-                                   "select_scan")
+                                   "select_scan", "regen_code")
                          for b in BUCKETS}
     fastest = {b: max((res[ln][b], ln) for ln in res)[1]
                for b in ("<64K", "64K-1M", "1-4M", "4-16M")}

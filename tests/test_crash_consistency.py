@@ -158,7 +158,7 @@ def _arm(node, point, after=0):
     assert res["ok"] and res["active"]
 
 
-def _drive_puts_until_dead(node, key, body, timeout=60):
+def _drive_puts_until_dead(node, key, body, timeout=60, headers=None):
     """PUT the new body in a loop until the armed crash point kills
     the process; assert the death is the crash exit, not an
     accident."""
@@ -166,7 +166,7 @@ def _drive_puts_until_dead(node, key, body, timeout=60):
     deadline = time.time() + timeout
     while time.time() < deadline and node.alive():
         try:
-            c.put_object("crashb", key, body)
+            c.put_object("crashb", key, body, headers=headers)
         except Exception:
             pass  # connection died mid-request: expected at the kill
     rc = node.wait_dead()
@@ -384,6 +384,85 @@ def test_heal_writeback_crash_point(node, point):
         except Exception:
             pass
     assert os.path.exists(os.path.join(victim, "crashb", key, "xl.meta"))
+
+
+# ---------------------------------------------------------------------------
+# REGEN storage class through the same crash points: the non-systematic
+# regen commit path and its minimum-bandwidth heal write-back obey the
+# identical atomicity contract as plain RS.
+
+REGEN_HDR = {"x-amz-storage-class": "REGEN"}
+
+REGEN_PUT_POINTS = [
+    ("engine.put.post_stage", 0, "old"),
+    ("xl.rename_data.post_replace", 4, "either"),
+    ("engine.put.post_commit", 0, "new"),
+]
+
+
+@pytest.mark.parametrize("point,after,expect", REGEN_PUT_POINTS,
+                         ids=[p for p, _, _ in REGEN_PUT_POINTS])
+def test_regen_put_crash_point(node, point, after, expect):
+    key = "regenput-" + point.replace(".", "-")
+    old = (b"OLDREGEN:" + point.encode() + b":") * 3000
+    new = os.urandom(96_000)
+    c = node.client()
+    assert c.put_object("crashb", key, old,
+                        headers=REGEN_HDR).status == 200
+    _arm(node, point, after=after)
+    _drive_puts_until_dead(node, key, new, headers=REGEN_HDR)
+    node.start()
+    served = _assert_invariants(node, key, old, new)
+    if expect == "old":
+        assert served == old, f"{point}: pre-quorum death must not publish"
+    elif expect == "new":
+        assert served == new, f"{point}: post-quorum death must serve the commit"
+    _assert_staging_drains(node)
+
+
+@pytest.mark.parametrize("point", ["engine.heal.mid_append",
+                                   "engine.heal.pre_commit"])
+def test_regen_heal_writeback_crash_point(node, point):
+    """Kill -9 inside the REGEN minimum-bandwidth write-back: the k
+    survivors still serve byte-exact, the requeued heal reconverges,
+    and the repaired shard lands on the victim disk."""
+    import shutil
+    key = "regenheal-" + point.replace(".", "-")
+    body = os.urandom(200_000)
+    c = node.client()
+    assert c.put_object("crashb", key, body,
+                        headers=REGEN_HDR).status == 200
+    victim = None
+    for d in node.disks:
+        objdir = os.path.join(d, "crashb", key)
+        if os.path.isdir(objdir):
+            victim = d
+            shutil.rmtree(objdir)
+            break
+    assert victim
+    _arm(node, point)
+    try:
+        node.admin().heal("crashb", key)
+    except Exception:
+        pass
+    rc = node.wait_dead()
+    assert rc == EXIT_CRASH, f"unexpected death rc={rc}"
+    node.start()
+    g = node.client().get_object("crashb", key)
+    assert g.status == 200 and g.body == body
+    _assert_staging_drains(node)
+    node.admin().heal("crashb", key)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if os.path.exists(os.path.join(victim, "crashb", key, "xl.meta")):
+            break
+        time.sleep(0.25)
+        try:
+            node.admin().heal("crashb", key)
+        except Exception:
+            pass
+    assert os.path.exists(os.path.join(victim, "crashb", key, "xl.meta"))
+    assert node.client().get_object("crashb", key).body == body
 
 
 # ---------------------------------------------------------------------------

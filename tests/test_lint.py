@@ -337,6 +337,52 @@ def test_storage_api_error_runtime_mapping():
     assert s3err.storage_api_error(ValueError("not storage")) is None
 
 
+def test_r4_auto_scopes_regen_kernel_module():
+    """The regen product-matrix kernels live under minio_tpu/ops/, so
+    R4's purity scope covers them by construction — a side effect in a
+    jit region of rs_regen.py is a finding, and the shipped module
+    itself is clean under the rule."""
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def regen_project(x):\n"
+        "    print('leak')\n"
+        "    return x\n")
+    findings = _check(KernelPurityRule(), src, "minio_tpu/ops/rs_regen.py")
+    assert len(findings) == 1 and "print" in findings[0].message
+    import minio_tpu.ops.rs_regen as rr
+    with open(rr.__file__) as f:
+        real = ModuleCtx(rr.__file__, f.read())
+    real.relpath = "minio_tpu/ops/rs_regen.py"
+    assert KernelPurityRule().applies(real)
+    assert KernelPurityRule().check(real) == []
+
+
+def test_r5_regen_repair_failed_mapped():
+    """RegenRepairFailed is a first-class storage error: the checked-in
+    map carries a literal entry (R5 fixpoint over the real files) and
+    the runtime mapping answers the retryable SlowDown — a failed
+    minimum-bandwidth repair is a retry-me, not a 500."""
+    import minio_tpu.s3.errors as s3e
+    import minio_tpu.storage.errors as se
+    ctxs = []
+    for mod, rel in ((se, "minio_tpu/storage/errors.py"),
+                     (s3e, "minio_tpu/s3/errors.py")):
+        with open(mod.__file__) as f:
+            ctx = ModuleCtx(mod.__file__, f.read())
+        ctx.relpath = rel
+        ctxs.append(ctx)
+    assert ErrorMapRule().check_project(ctxs) == []
+    from minio_tpu.storage import errors as serr
+    assert s3e.storage_api_error(serr.RegenRepairFailed("x")) is \
+        s3e.ERR_SLOW_DOWN
+
+    class SubRegen(serr.RegenRepairFailed):
+        pass
+
+    assert s3e.storage_api_error(SubRegen("x")) is s3e.ERR_SLOW_DOWN
+
+
 # ---------------------------------------------------------------------------
 # R6 — retry loops bounded + backed off
 

@@ -1,0 +1,327 @@
+"""REGEN storage class: golden vectors for the product-matrix MBR
+kernels against a slow pure-scalar oracle, plus the counting-disk proof
+that minimum-bandwidth repair never reads k full shards.
+
+The oracle recomputes every stored symbol through the defining bilinear
+form P = Psi @ M @ Psi^t with scalar gf_mul loops — independent of the
+batched generator-tensor path in ops/rs_regen.py, so agreement pins the
+construction, not the implementation.
+"""
+
+import itertools
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from minio_tpu.erasure.engine import ErasureObjects
+from minio_tpu.erasure.regen.codec import RegenErasure
+from minio_tpu.erasure.regen.repair import REPAIR_BYTES
+from minio_tpu.ops import rs_regen
+from minio_tpu.ops.gf256 import gf_mul
+from minio_tpu.ops.rs_matrix import vandermonde
+from minio_tpu.storage import errors as serr
+from minio_tpu.storage.metadata import REGEN_ALGORITHM
+from minio_tpu.storage.xl import XLStorage
+
+
+# ---------------------------------------------------------------------------
+# pure-scalar oracle
+
+
+def oracle_chunks(k: int, m: int, data: bytes) -> list[bytes]:
+    """Every node's stored chunk for one block, computed symbol by
+    symbol from the definition: message matrix M per stripe, full
+    product P = Psi M Psi^t via scalar gf_mul, node i storing its
+    off-diagonal row (P[i, j] : j != i) with row r contiguous at byte
+    offset r * nst."""
+    n, d = k + m, k + m - 1
+    B = k * d - k * (k - 1) // 2
+    nst = -(-len(data) // B)
+    padded = bytearray(nst * B)
+    padded[:len(data)] = data
+    psi = vandermonde(n, d)
+    # basis slot order: S upper triangle row-major, then T row-major
+    slots = [(i, j) for i in range(k) for j in range(i, k)]
+    slots += [(i, j) for i in range(k) for j in range(k, d)]
+    chunks = [bytearray(d * nst) for _ in range(n)]
+    for s in range(nst):
+        w = padded[s * B:(s + 1) * B]
+        M = [[0] * d for _ in range(d)]
+        for t, (i, j) in enumerate(slots):
+            M[i][j] = w[t]
+            M[j][i] = w[t]
+        P = [[0] * n for _ in range(n)]
+        for i in range(n):
+            for j in range(n):
+                acc = 0
+                for a in range(d):
+                    for b in range(d):
+                        acc ^= gf_mul(int(psi[i, a]),
+                                      gf_mul(M[a][b], int(psi[j, b])))
+                P[i][j] = acc
+        for i in range(n):
+            r = 0
+            for j in range(n):
+                if j == i:
+                    continue
+                chunks[i][r * nst + s] = P[i][j]
+                r += 1
+    return [bytes(c) for c in chunks]
+
+
+GEOMETRIES = [(4, 2), (3, 3), (2, 2)]
+
+
+@pytest.mark.parametrize("k,m", GEOMETRIES)
+def test_encode_matches_oracle(k, m):
+    rng = np.random.default_rng(k * 100 + m)
+    data = rng.integers(0, 256, 257, dtype=np.uint8).tobytes()
+    codec = RegenErasure(k, m, block_size=1024, backend="cpu")
+    got = codec.encode_data(data)
+    want = oracle_chunks(k, m, data)
+    for i in range(k + m):
+        assert got[i].tobytes() == want[i], f"node {i} chunk mismatch"
+
+
+@pytest.mark.parametrize("k,m", GEOMETRIES)
+def test_decode_every_erasure_pattern(k, m):
+    """Byte-exact round trip from every surviving-node subset left by
+    up to m losses (MBR promise: any k nodes decode)."""
+    n = k + m
+    rng = np.random.default_rng(k * 10 + m)
+    data = rng.integers(0, 256, 501, dtype=np.uint8).tobytes()
+    codec = RegenErasure(k, m, block_size=1024, backend="cpu")
+    chunks = codec.encode_data(data)
+    for nlost in range(m + 1):
+        for lost in itertools.combinations(range(n), nlost):
+            shards = [None if i in lost else chunks[i] for i in range(n)]
+            out = codec.decode_blocks_batch([shards], [len(data)])
+            assert out[0] == data, f"lost={lost}"
+
+
+@pytest.mark.parametrize("k,m", GEOMETRIES)
+def test_repair_by_transfer_every_node(k, m):
+    """The repair plan's shipped symbols ARE the lost chunk: for every
+    failed node, assembling helper_row slices per the plan reproduces
+    its stored chunk byte-exactly — no math at the rebuilder."""
+    n = k + m
+    rng = np.random.default_rng(3 * k + m)
+    data = rng.integers(0, 256, 400, dtype=np.uint8).tobytes()
+    codec = RegenErasure(k, m, block_size=1024, backend="cpu")
+    chunks = codec.encode_data(data)
+    nst = codec.stripe_count(len(data))
+    for failed in range(n):
+        plan = rs_regen.repair_rows(k, m, failed)
+        assert len(plan) == n - 1
+        rebuilt = bytearray(codec.chunk_size(len(data)))
+        for helper, helper_row, dest_row in plan:
+            row = chunks[helper][helper_row * nst:(helper_row + 1) * nst]
+            rebuilt[dest_row * nst:(dest_row + 1) * nst] = \
+                row.tobytes()
+        assert bytes(rebuilt) == chunks[failed].tobytes(), \
+            f"failed={failed}"
+
+
+@pytest.mark.parametrize("k,m", [(4, 2), (3, 3)])
+def test_reencode_missing_matches_encode(k, m):
+    """Conventional-fallback repair (any-k decode + re-encode of the
+    lost nodes) reproduces the original chunks byte-exactly, for every
+    single-loss case and a double-loss case."""
+    n = k + m
+    rng = np.random.default_rng(17)
+    data = rng.integers(0, 256, 333, dtype=np.uint8).tobytes()
+    codec = RegenErasure(k, m, block_size=1024, backend="cpu")
+    chunks = codec.encode_data(data)
+    for missing in [[f] for f in range(n)] + [[0, n - 1]]:
+        shards = [None if i in missing else chunks[i] for i in range(n)]
+        out = codec.reencode_missing_batch([shards], [len(data)],
+                                           missing)
+        for f in missing:
+            assert out[0][f] == chunks[f].tobytes(), f"missing={missing}"
+
+
+def test_shard_sizes_consistent():
+    codec = RegenErasure(4, 2, block_size=8192)
+    g = codec.g
+    assert (g.n, g.d, g.B) == (6, 5, 14)
+    assert codec.shard_size() == g.d * (-(-8192 // g.B))
+    # shard_file_size = full blocks + tail chunk
+    total = 8192 * 2 + 100
+    assert codec.shard_file_size(total) == \
+        2 * codec.shard_size() + codec.chunk_size(100)
+    assert codec.shard_file_size(0) == 0
+
+
+# ---------------------------------------------------------------------------
+# engine integration + counting-disk proof
+
+
+class CountingDisk:
+    """Counts bytes served through the storage READ API per method —
+    the repair data plane.  (verify_file's internal deep-scan reads
+    happen inside the wrapped disk and are disk-local even in
+    distributed mode, so they don't route through these counters.)"""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.bytes_by_method = {"read_all": 0, "read_file": 0,
+                                "repair_project": 0}
+        self.part_read_alls = 0
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def read_all(self, volume, path):
+        data = self.inner.read_all(volume, path)
+        self.bytes_by_method["read_all"] += len(data)
+        if "/part." in path:
+            self.part_read_alls += 1
+        return data
+
+    def read_file(self, volume, path, offset, length):
+        data = self.inner.read_file(volume, path, offset, length)
+        self.bytes_by_method["read_file"] += len(data)
+        return data
+
+    def repair_project(self, volume, path, ranges):
+        data = self.inner.repair_project(volume, path, ranges)
+        self.bytes_by_method["repair_project"] += len(data)
+        return data
+
+
+def make_regen_engine(tmp_path, n=6, block_size=8192, counting=False):
+    disks = []
+    for i in range(n):
+        d = XLStorage(str(tmp_path / f"disk{i}"))
+        disks.append(CountingDisk(d) if counting else d)
+    e = ErasureObjects(disks, n - 2, 2, block_size=block_size)
+    e.make_bucket("b")
+    return e
+
+
+def test_engine_put_get_regen_and_mixed_bucket(tmp_path):
+    eng = make_regen_engine(tmp_path)
+    payload = os.urandom(50_000)
+    eng.put_object("b", "rs-obj", payload)
+    eng.put_object("b", "regen-obj", payload, algorithm=REGEN_ALGORITHM)
+    # algorithm stamped in xl.meta; RS object untouched
+    fi = eng.disks[0].read_version("b", "regen-obj")
+    assert fi.erasure.algorithm == REGEN_ALGORITHM
+    fi_rs = eng.disks[0].read_version("b", "rs-obj")
+    assert fi_rs.erasure.algorithm != REGEN_ALGORITHM
+    for key in ("rs-obj", "regen-obj"):
+        got, _ = eng.get_object("b", key)
+        assert got == payload
+    # ranged read across a block boundary
+    got, _ = eng.get_object("b", "regen-obj", offset=6000, length=20_000)
+    assert got == payload[6000:26_000]
+
+
+def test_engine_degraded_get_regen(tmp_path):
+    eng = make_regen_engine(tmp_path)
+    payload = os.urandom(40_000)
+    eng.put_object("b", "obj", payload, algorithm=REGEN_ALGORITHM)
+    for i in (1, 3):  # m = 2 losses still decode
+        shutil.rmtree(os.path.join(eng.disks[i].root, "b", "obj"))
+    got, _ = eng.get_object("b", "obj")
+    assert got == payload
+
+
+def test_regen_heal_never_reads_k_full_shards(tmp_path):
+    """The counting-disk proof: a single-shard REGEN repair's data
+    plane moves only the d stored rows per block — strictly less than
+    ONE full shard stream, and nowhere near the k full shards the
+    conventional path reads.  Helper reads arrive via repair_project
+    (the one-RPC projection read), never as part-file read_alls."""
+    eng = make_regen_engine(tmp_path, counting=True)
+    payload = os.urandom(100_000)
+    eng.put_object("b", "obj", payload, algorithm=REGEN_ALGORITHM)
+    shutil.rmtree(os.path.join(eng.disks[2].inner.root, "b", "obj"))
+
+    for d in eng.disks:
+        d.bytes_by_method = {k: 0 for k in d.bytes_by_method}
+        d.part_read_alls = 0
+    REPAIR_BYTES.reset()
+    res = eng.healer.heal_object("b", "obj")
+    assert res.healed_disks and res.healthy
+
+    codec = RegenErasure(4, 2, block_size=8192)
+    one_shard = codec.shard_file_size(len(payload))
+    proj = sum(d.bytes_by_method["repair_project"] for d in eng.disks)
+    ranged = sum(d.bytes_by_method["read_file"] for d in eng.disks)
+    assert proj > 0, "min-bandwidth path never engaged"
+    # Repair-by-transfer optimality: the helpers collectively ship
+    # exactly the bytes being rebuilt — one shard stream, not the k
+    # full shards (4x that) the conventional path reads, and well
+    # under half the d/B = 5/14 of the plain object size.
+    assert proj + ranged <= one_shard, \
+        f"repair read {proj + ranged} > one shard {one_shard}"
+    assert proj + ranged < 4 * one_shard  # the literal k-shards bound
+    assert proj + ranged < len(payload) // 2
+    assert sum(d.part_read_alls for d in eng.disks) == 0, \
+        "repair fell back to full shard streams"
+    snap = REPAIR_BYTES.snapshot()
+    assert snap["regen"]["disk"] == snap["regen"]["net"] == proj
+    got, _ = eng.get_object("b", "obj")
+    assert got == payload
+
+
+def test_regen_heal_falls_back_when_helper_down(tmp_path):
+    """One unreachable helper mid-repair downgrades to the any-k
+    conventional path — heal still converges byte-exactly."""
+    eng = make_regen_engine(tmp_path, counting=True)
+    payload = os.urandom(60_000)
+    eng.put_object("b", "obj", payload, algorithm=REGEN_ALGORITHM)
+    before = {i: open(_part_file(eng, i, "b", "obj"), "rb").read()
+              for i in range(6)}
+    shutil.rmtree(os.path.join(eng.disks[2].inner.root, "b", "obj"))
+
+    calls = {"n": 0}
+    victim = eng.disks[4]
+    orig = victim.inner.repair_project
+
+    def flaky(volume, path, ranges):
+        calls["n"] += 1
+        raise serr.FaultyDisk("injected helper outage")
+
+    victim.inner.repair_project = flaky
+    try:
+        res = eng.healer.heal_object("b", "obj")
+    finally:
+        victim.inner.repair_project = orig
+    assert calls["n"] >= 1, "fault never exercised"
+    assert res.healed_disks and res.healthy
+    # Rebuilt shard is byte-identical to what the PUT wrote.
+    assert open(_part_file(eng, 2, "b", "obj"), "rb").read() == before[2]
+    got, _ = eng.get_object("b", "obj")
+    assert got == payload
+
+
+def test_regen_repair_failed_when_below_k(tmp_path):
+    """Fewer than k readable chunks: the heal raises the typed
+    RegenRepairFailed (mapped to a retryable S3 SlowDown)."""
+    eng = make_regen_engine(tmp_path)
+    payload = os.urandom(30_000)
+    eng.put_object("b", "obj", payload, algorithm=REGEN_ALGORITHM)
+    # 3 of 6 gone: below k=4 — dangling, not healable.
+    for i in (0, 2, 4):
+        shutil.rmtree(os.path.join(eng.disks[i].root, "b", "obj"))
+    res = eng.healer.heal_object("b", "obj")
+    assert res.dangling or not res.healed_disks
+    from minio_tpu.s3 import errors as s3err
+    assert s3err.storage_api_error(
+        serr.RegenRepairFailed("x")) is s3err.ERR_SLOW_DOWN
+
+
+def _part_file(eng, i, bucket, obj):
+    root = (eng.disks[i].inner.root
+            if isinstance(eng.disks[i], CountingDisk)
+            else eng.disks[i].root)
+    obj_dir = os.path.join(root, bucket, obj)
+    for entry in os.listdir(obj_dir):
+        p = os.path.join(obj_dir, entry)
+        if os.path.isdir(p):
+            return os.path.join(p, "part.1")
+    raise FileNotFoundError(obj_dir)

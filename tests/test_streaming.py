@@ -137,6 +137,7 @@ def test_put_get_memory_stays_o_batch(tmp_path):
     e.put_batch_bytes = 1 << 20
     e.read_group_bytes = 1 << 20
     n_chunks = 64  # 64 x 1MiB
+    _drain_probe_ladder()
 
     tracemalloc.start()
     info = e.put_object("big", "obj", _pattern_chunks(n_chunks))
@@ -198,6 +199,18 @@ def test_streaming_create_file_local(tmp_path):
 # S3 server streaming (PUT body never buffered; GET streams to socket)
 
 
+def _drain_probe_ladder():
+    """The first dispatch (or a server boot) kicks the background
+    probe ladder; its probe buffers would land inside the memory
+    tests' tracemalloc windows — drain it first, same reason bench.py
+    drains before its paired measurements."""
+    from minio_tpu.ops.autotune import AUTOTUNE
+    t = AUTOTUNE._probe_thread
+    if t is not None and t.is_alive():
+        t.join(timeout=120)
+    AUTOTUNE.ensure_probed(background=False)
+
+
 @pytest.fixture
 def s3_server(tmp_path):
     from minio_tpu.s3.server import S3Server
@@ -206,6 +219,7 @@ def s3_server(tmp_path):
                    "streamadmin", "streamsecret")
     srv.stream_threshold = 128 * 1024  # exercise the streaming path
     port = srv.start()
+    _drain_probe_ladder()
     yield srv, port
     srv.stop()
 
