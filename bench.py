@@ -1226,11 +1226,18 @@ def bench_front_door(np, workdir: str) -> dict:
        front door on identical layers, alternating pairs (PR-4's
        method — this VM drifts on second timescales, pairing cancels
        it); the event loop must cost ~nothing at today's workloads.
+    4. distributed fan-out: a 2-node cluster (half the erasure set
+       behind peer RPC) drives paired async-vs-threaded RPC-fabric
+       PUTs (same alternating-pair method, flipping MINIO_RPC_FABRIC
+       per call), then parks 1k concurrent peer calls on the RPC loop
+       and reads the in-flight census against the process thread
+       count — the zero-thread-per-call claim, stamped.
 
     Tripwires raise (bench records the failure): p99 flatness
     (10k within 2x of 100-conn p99 plus a 15ms scheduling-jitter
     floor — two python processes on 2 cores), zero loadgen framing
-    errors, zero admission-slot leaks, put_p50 delta within noise.
+    errors, zero admission-slot leaks, put_p50 delta within noise,
+    census >= 900 of 1k in flight with <= 8 extra threads.
     """
     import statistics as stats
     import subprocess
@@ -1446,6 +1453,8 @@ def bench_front_door(np, workdir: str) -> dict:
         reconnect_delta_pct = stats.median(rc_deltas) \
             / max(stats.median(rc_t), 1e-9) * 100.0
 
+        fanout = _bench_fanout_fabric(stats, workdir, access, secret)
+
         return {
             "metric": "front_door",
             "value": round(p99_10k / max(p99_100, 1e-9), 3),
@@ -1470,12 +1479,203 @@ def bench_front_door(np, workdir: str) -> dict:
             # loop hop per socket (real SDKs keep connections alive).
             "put_p50_reconnect_delta_pct": round(reconnect_delta_pct,
                                                  2),
+            "fanout": fanout,
         }
     finally:
         if srv_t is not None:
             srv_t.stop()
         srv.stop()
         shutil.rmtree(root, ignore_errors=True)
+
+
+def _bench_fanout_fabric(stats, workdir: str, access: str,
+                         secret: str) -> dict:
+    """Distributed fan-out step: 2-node cluster, half of every erasure
+    stripe behind peer RPC.
+
+    (a) Paired RPC-fabric PUTs: each front-door PUT on node 0 fans its
+    remote shards out over the internal RPC plane; MINIO_RPC_FABRIC is
+    flipped per call (the knob is read at dispatch time) in
+    alternating pair order, so VM drift cancels and the async fabric's
+    cost shows up as a paired delta, not an absolute.
+
+    (b) In-flight census: 1k concurrent peer calls submitted straight
+    onto the RPC loop against a registered nap service on node 1 —
+    client-side in-flight peaks near 1k while the process grows ~zero
+    threads (the in-process SERVER'S bounded rpc pool is pre-warmed to
+    cap so it cannot pollute the delta).
+    """
+    import http.client as _hc
+
+    from minio_tpu.rpc import aio as _aio
+    from minio_tpu.rpc.cluster import build_cluster_node, \
+        derive_cluster_key
+    from minio_tpu.rpc.transport import RPCClient, RPCRegistry
+    from minio_tpu.s3 import sigv4 as _sigv4
+    from minio_tpu.s3.client import S3Client
+    from minio_tpu.s3.server import S3Server
+
+    croot = os.path.join(workdir, "cfg_fd_cluster")
+    key = derive_cluster_key(access, secret)
+    servers, ports = [], []
+    for _ in range(2):
+        reg = RPCRegistry(key)
+        srv = S3Server(None, access, secret, rpc_registry=reg)
+        ports.append(srv.start("127.0.0.1", 0))
+        servers.append((srv, reg))
+    endpoints = [f"http://127.0.0.1:{p}{croot}/n{i}/d{d}"
+                 for i, p in enumerate(ports) for d in (1, 2)]
+
+    nodes = [None, None]
+    errors: list = []
+
+    def boot_node(i):
+        try:
+            srv, reg = servers[i]
+            node = build_cluster_node(
+                endpoints, "127.0.0.1", ports[i], access, secret,
+                block_size=256 * 1024, registry=reg,
+                format_timeout=30.0)
+            srv.set_layer(node.layer)
+            nodes[i] = node
+        except Exception as e:  # pragma: no cover - bench plumbing
+            errors.append(e)
+
+    threads = [threading.Thread(target=boot_node, args=(i,))
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    if errors or any(n is None for n in nodes):
+        raise RuntimeError(f"cluster boot failed: {errors}")
+
+    rcl = None
+    prev_fabric = os.environ.get("MINIO_RPC_FABRIC")
+    try:
+        cl0 = S3Client("127.0.0.1", ports[0], access, secret)
+        if cl0.make_bucket("fan").status != 200:
+            raise RuntimeError("cluster make_bucket failed")
+        body = os.urandom(1024 * 1024)
+
+        def timed_put(conn, tag, i) -> float:
+            path = f"/fan/{tag}-{i}"
+            hdrs = _sigv4.sign_request(
+                "PUT", path, "",
+                {"host": f"127.0.0.1:{ports[0]}",
+                 "content-length": str(len(body))},
+                body, access, secret, "us-east-1")
+            t0 = time.perf_counter()
+            conn.request("PUT", path, body=body, headers=hdrs)
+            r = conn.getresponse()
+            r.read()
+            if r.status != 200:
+                raise RuntimeError(f"cluster PUT failed: {r.status}")
+            return (time.perf_counter() - t0) * 1e3
+
+        def fabric_put(conn, fabric, tag, i) -> float:
+            os.environ["MINIO_RPC_FABRIC"] = fabric
+            try:
+                return timed_put(conn, tag, i)
+            finally:
+                if prev_fabric is None:
+                    os.environ.pop("MINIO_RPC_FABRIC", None)
+                else:
+                    os.environ["MINIO_RPC_FABRIC"] = prev_fabric
+
+        conn = _hc.HTTPConnection("127.0.0.1", ports[0], timeout=60)
+        for i in range(2):  # warm both fabrics' pools + codec
+            fabric_put(conn, "async", "wa", i)
+            fabric_put(conn, "threaded", "wt", i)
+        lat_a, lat_t, deltas = [], [], []
+        for i in range(12):
+            if i % 2 == 0:
+                a = fabric_put(conn, "async", "fa", i)
+                t = fabric_put(conn, "threaded", "ft", i)
+            else:
+                t = fabric_put(conn, "threaded", "ft", i)
+                a = fabric_put(conn, "async", "fa", i)
+            lat_a.append(a)
+            lat_t.append(t)
+            deltas.append(a - t)
+        conn.close()
+        rpc_p50_a, rpc_p50_t = stats.median(lat_a), stats.median(lat_t)
+        rpc_p99_a = sorted(lat_a)[-1]
+        rpc_p99_t = sorted(lat_t)[-1]
+        rpc_delta_pct = stats.median(deltas) \
+            / max(rpc_p50_t, 1e-9) * 100.0
+
+        # -- census: 1k concurrent peer calls, ~zero new threads -----
+        class _Nap:
+            def rpc_nap(self, args, payload):
+                time.sleep(args.get("sleepS", 0.02))
+                return {}, b""
+
+        servers[1][1].register("benchnap", _Nap())
+        rcl = RPCClient("127.0.0.1", ports[1], key)
+        # Pre-warm the in-process SERVER's bounded rpc worker pool to
+        # its cap so pool spin-up can't masquerade as client threads.
+        warm = [_aio.RPC_LOOP.submit(_aio.call_async(
+            rcl, "benchnap", "nap", {"sleepS": 0.01}, timeout=30.0))
+            for _ in range(64)]
+        for f in warm:
+            f.result(timeout=60)
+        n = 1000
+        threads_before = threading.active_count()
+        futs = [_aio.RPC_LOOP.submit(_aio.call_async(
+            rcl, "benchnap", "nap", {"sleepS": 0.02}, timeout=60.0))
+            for _ in range(n)]
+        peak = 0
+        threads_at_peak = threads_before
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            cur = _aio.CENSUS.current()
+            if cur > peak:
+                peak = cur
+                threads_at_peak = threading.active_count()
+            if all(f.done() for f in futs):
+                break
+            time.sleep(0.002)
+        fails = 0
+        for f in futs:
+            try:
+                f.result(timeout=120)
+            except Exception:
+                fails += 1
+        extra_threads = threads_at_peak - threads_before
+        if fails:
+            raise RuntimeError(f"{fails}/{n} census peer calls failed")
+        if peak < 900:
+            raise RuntimeError(
+                f"census never saw the fleet in flight: peak {peak}")
+        if extra_threads > 8:
+            raise RuntimeError(
+                f"async fabric grew {extra_threads} threads at {peak} "
+                "in-flight peer calls — the zero-thread claim broke")
+        return {
+            "rpc_put_p50_async_ms": round(rpc_p50_a, 3),
+            "rpc_put_p50_threaded_ms": round(rpc_p50_t, 3),
+            "rpc_put_p99_async_ms": round(rpc_p99_a, 3),
+            "rpc_put_p99_threaded_ms": round(rpc_p99_t, 3),
+            # Median PAIRED delta over the threaded median — negative
+            # = the async fabric is faster end-to-end.
+            "rpc_put_paired_delta_pct": round(rpc_delta_pct, 2),
+            "census_calls": n,
+            "census_peak_inflight": peak,
+            "threads_before": threads_before,
+            "threads_at_peak": threads_at_peak,
+            "extra_threads_at_peak": extra_threads,
+        }
+    finally:
+        if prev_fabric is None:
+            os.environ.pop("MINIO_RPC_FABRIC", None)
+        else:
+            os.environ["MINIO_RPC_FABRIC"] = prev_fabric
+        if rcl is not None:
+            rcl.close()
+        for srv, _reg in servers:
+            srv.stop()
+        shutil.rmtree(croot, ignore_errors=True)
 
 
 def bench_crash_recovery(np, workdir: str) -> dict:

@@ -556,6 +556,11 @@ METRICS2.register(
     "Connections accepted but not yet established (TLS handshake / "
     "loop handoff in flight).")
 METRICS2.register(
+    "minio_tpu_v2_rpc_inflight", "gauge",
+    "Internal peer RPCs currently in flight on this node (client "
+    "side, both fabrics) — pair with the process thread count to "
+    "verify the async fabric's zero-thread-per-call claim.")
+METRICS2.register(
     "minio_tpu_v2_connections_accepted_total", "counter",
     "Client connections accepted by the front door.")
 METRICS2.register(

@@ -239,6 +239,12 @@ class Timeline:
                 m("minio_tpu_v2_accept_queue_depth")),
             "parseErrors": _series_sum(
                 m("minio_tpu_v2_conn_parse_errors_total")),
+            # Internal RPC fabric (rpc/aio.py): client-side peer calls
+            # in flight paired with the PROCESS thread count — flat
+            # threads under a fan-out spike is the async fabric's
+            # zero-thread-per-call claim, visible per node.
+            "rpcInflight": _series_sum(m("minio_tpu_v2_rpc_inflight")),
+            "threads": threading.active_count(),
             # Analytics scan volume (s3select): decoded bytes +
             # queries, delta'd into a select GiB/s row in mtpu_top.
             "selectProcessed": _series_sum(
@@ -336,6 +342,8 @@ class Timeline:
                 "acceptQueue": raw.get("acceptQueue", 0),
                 "parseErrors": _d(raw.get("parseErrors", 0),
                                   prev.get("parseErrors", 0)),
+                "rpcInflight": raw.get("rpcInflight", 0),
+                "threads": raw.get("threads", 0),
                 "selectProcessed": _d(raw.get("selectProcessed", 0),
                                       prev.get("selectProcessed", 0)),
                 "selectRequests": _d(raw.get("selectRequests", 0),
@@ -459,6 +467,8 @@ def _collapse_node(snap: dict, period_s: float) -> list[dict]:
             "conns": last.get("conns", 0),
             "acceptQueue": last.get("acceptQueue", 0),
             "parseErrors": 0,
+            "rpcInflight": last.get("rpcInflight", 0),
+            "threads": last.get("threads", 0),
             "mrfDepth": last.get("mrfDepth", 0),
             "mrfJournal": last.get("mrfJournal", 0),
             "drives": dict(last.get("drives") or {}),
@@ -525,6 +535,7 @@ def merge_timelines(snapshots: list[dict],
                     "kernelBytes": {}, "kernelGiBs": {},
                     "hedgeFired": 0, "mrfDepth": 0, "mrfJournal": 0,
                     "conns": 0, "acceptQueue": 0, "parseErrors": 0,
+                    "rpcInflight": 0, "threads": 0,
                     "resets": 0,
                     "selectProcessed": 0, "selectRequests": 0,
                     "cacheHits": 0, "cacheMisses": 0,
@@ -546,6 +557,7 @@ def merge_timelines(snapshots: list[dict],
                         "mrfDepth", "mrfJournal", "cacheHits",
                         "cacheMisses", "cacheFills", "cacheBytes",
                         "conns", "acceptQueue", "parseErrors",
+                        "rpcInflight", "threads",
                         "resets", "selectProcessed",
                         "selectRequests"):
                 cur[fld] += s.get(fld, 0)

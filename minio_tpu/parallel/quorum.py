@@ -53,6 +53,19 @@ def _release(n: int) -> None:
             _ACTIVE -= n
 
 
+def stats() -> dict:
+    """Fan-out pool census for the bench/observability surfaces:
+    workers the pool may park vs how many a fan-out holds RIGHT NOW —
+    the threaded half of the thread-vs-inflight comparison the async
+    RPC fabric is measured against (rpc/aio.py census)."""
+    with _POOL_LOCK:
+        active = _ACTIVE
+        started = len(getattr(_POOL, "_threads", ())) if _POOL else 0
+    return {"active": active, "started": started,
+            "workers": _POOL_WORKERS,
+            "processThreads": threading.active_count()}
+
+
 class QuorumError(Exception):
     """Not enough disks agreed/succeeded."""
 

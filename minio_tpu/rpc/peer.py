@@ -287,6 +287,16 @@ class NotificationSys:
         if not self.peers:
             return results
 
+        # Async fabric (rpc/aio.py): N peers become N coroutines on
+        # the process-wide RPC loop — the caller blocks on ONE future,
+        # zero fan-out threads. Falls through to the thread path when
+        # the fabric is off or a peer isn't a real RPCClient (test
+        # doubles, in-process loopbacks).
+        from . import aio
+        fabric = aio.fanout(self.peers, method, args, timeout=timeout)
+        if fabric is not None:
+            return fabric
+
         def one(key: str, client: RPCClient) -> None:
             try:
                 results[key], _ = client.call("peer", method, args,
@@ -310,6 +320,12 @@ class NotificationSys:
 
     def _fanout_async(self, method: str, args: dict) -> None:
         """Push without blocking the mutating request on peer RPCs."""
+        from . import aio
+        if aio.fanout_nowait(self.peers, method, args):
+            # Scheduled on the RPC loop deadline-free and span-free:
+            # the push must OUTLIVE the mutating request (same
+            # contract the daemon-thread fallback encodes below).
+            return
         # mtpu-lint: disable=R1 -- fire-and-forget push must OUTLIVE the request; inheriting its deadline would cancel the notify
         threading.Thread(target=self._fanout, args=(method, args),
                          daemon=True).start()

@@ -9,6 +9,7 @@ RemoteStorage implements StorageAPI against a peer's service.
 from __future__ import annotations
 
 import base64
+import contextlib
 
 from ..storage import errors as serr
 from ..storage.interface import StorageAPI
@@ -19,6 +20,8 @@ from .transport import RPCClient
 # Entries per walk_dir RPC page: bounds both the frame size (~300B per
 # single-version entry -> ~300KiB pages) and server/client memory.
 WALK_PAGE_ENTRIES = 1000
+
+_NULL_CTX = contextlib.nullcontext()
 
 
 def _fi_to_wire(fi: FileInfo) -> dict:
@@ -283,7 +286,15 @@ class RemoteStorage(StorageAPI):
         # Streamed write: first chunk creates/truncates, the rest append
         # — one bounded RPC frame per chunk, never the whole object
         # (ref storageRESTClient.CreateFile streaming body,
-        # cmd/storage-rest-client.go).
+        # cmd/storage-rest-client.go). On the async fabric the chunk
+        # frames ride ONE pipelined connection (up to aio.
+        # PIPELINE_WINDOW in flight) so chunk N's upload overlaps the
+        # peer's disk write for chunks N-1..N-3 instead of paying a
+        # full round-trip stall per chunk.
+        from . import aio
+        if aio.fabric_async() and isinstance(self.client, RPCClient):
+            self._create_file_pipelined(volume, path, data)
+            return
         first = True
         for chunk in data:
             if first:
@@ -296,6 +307,53 @@ class RemoteStorage(StorageAPI):
         if first:  # empty stream still creates the file
             self._call("create_file", {"volume": volume, "path": path},
                        b"")
+
+    def _create_file_pipelined(self, volume: str, path: str,
+                               chunks) -> None:
+        """Streamed create over one pipelined connection. Chunk frames
+        carry no per-call trace header (a big object would mint one
+        server span per append); traced callers get a single
+        client-side span for the whole stream, and drive-health
+        accounting records one create_file covering the wire time the
+        quorum fan-out actually waited."""
+        from . import aio
+        from ..qos.deadline import current_deadline
+        ddl = current_deadline()
+        if ddl is not None:
+            ddl.check("rpc.storage.create_file")
+        import time as _time
+        from ..obs.drivemon import DRIVEMON, is_drive_fault
+        from ..obs.span import TRACER, current_span
+        a = {"disk": self.disk_path, "volume": volume, "path": path}
+        t0 = _time.perf_counter()
+        err = None
+        try:
+            span = (TRACER.span("rpc.storage.create_file",
+                                endpoint=self.client.endpoint(),
+                                disk=self.disk_path, pipelined=True)
+                    if current_span() is not None else None)
+            with span if span is not None else _NULL_CTX:
+                pipe = aio.Pipeline(self.client)
+                try:
+                    first = True
+                    for chunk in chunks:
+                        pipe.send("storage",
+                                  "create_file" if first
+                                  else "append_file", a, bytes(chunk))
+                        first = False
+                    if first:  # empty stream still creates the file
+                        pipe.send("storage", "create_file", a, b"")
+                    pipe.finish()
+                except BaseException:
+                    pipe.abort()
+                    raise
+        except BaseException as e:
+            err = e
+            raise
+        finally:
+            DRIVEMON.record(self._drive_key(), "create_file",
+                            (_time.perf_counter() - t0) * 1e3,
+                            error=is_drive_fault(err))
 
     def append_file(self, volume, path, data):
         self._call("append_file", {"volume": volume, "path": path},

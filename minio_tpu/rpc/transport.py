@@ -195,7 +195,26 @@ class RPCClient:
         calls that legitimately block server-side (e.g. a 3-30s trace
         long-poll) — such calls neither tune the dynamic timeout nor
         mark the peer offline on expiry, so a slow control-plane poll
-        can never knock a healthy peer out of the data plane."""
+        can never knock a healthy peer out of the data plane.
+
+        By default the call runs on the async fabric (rpc/aio.py): the
+        coroutine twin of the body below executes on the process-wide
+        RPC event loop and this thread blocks on its future — same
+        semantics, zero extra threads per in-flight call.
+        MINIO_RPC_FABRIC=threaded keeps the pooled http.client path."""
+        from . import aio
+        if aio.fabric_async():
+            return aio.bridge_call(self, service, method, args, payload,
+                                   timeout)
+        return self._call_threaded(service, method, args, payload,
+                                   timeout)
+
+    def _call_threaded(self, service: str, method: str, args: dict,
+                       payload: bytes = b"",
+                       timeout: float | None = None) -> tuple[dict, bytes]:
+        """Legacy thread-blocking transport (MINIO_RPC_FABRIC=threaded
+        and the paired fabric bench): one pooled http.client
+        connection, this thread parked on the socket."""
         if not self.is_online():
             raise serr.DiskNotFound(f"{self.endpoint()} offline")
         # Per-peer wire faults (minio_tpu/faultinject): an injected
@@ -251,79 +270,92 @@ class RPCClient:
         if _cur is not None:
             headers["x-mtpu-trace"] = f"{_cur.trace_id}:{_cur.span_id}"
         override = timeout is not None
-        conn, reused = self._get_conn(eff_timeout)
-        # mtpu-lint: disable=R6 -- single-shot retry, not a loop: the continue requires reused=True and a fresh socket comes back reused=False, so it fires at most once; no backoff by design (a stale pool is instant-fail, the peer is healthy)
-        while True:
-            t0 = time.monotonic()
-            logged = override
-            resp = None
-            try:
-                conn.request("POST", f"{RPC_PREFIX}/{service}/{method}",
-                             body=body, headers=headers)
-                resp = conn.getresponse()
-                rbody = resp.read()
-                if not override:
-                    self.dyn_timeout.log_success(time.monotonic() - t0)
-                logged = True
-                if resp.status != 200:
+        from .aio import CENSUS
+        CENSUS.enter()
+        try:
+            conn, reused = self._get_conn(eff_timeout)
+            # mtpu-lint: disable=R6 -- single-shot retry, not a loop: the continue requires reused=True and a fresh socket comes back reused=False, so it fires at most once; no backoff by design (a stale pool is instant-fail, the peer is healthy)
+            while True:
+                t0 = time.monotonic()
+                logged = override
+                resp = None
+                try:
+                    conn.request("POST",
+                                 f"{RPC_PREFIX}/{service}/{method}",
+                                 body=body, headers=headers)
+                    resp = conn.getresponse()
+                    rbody = resp.read()
+                    if not override:
+                        self.dyn_timeout.log_success(
+                            time.monotonic() - t0)
+                    logged = True
+                    if resp.status != 200:
+                        self._put_conn(conn)
+                        raise wire_to_error(resp.status, rbody)
+                    result_json, data = unframe(rbody)
                     self._put_conn(conn)
-                    raise wire_to_error(resp.status, rbody)
-                result_json, data = unframe(rbody)
-                self._put_conn(conn)
-                result = json.loads(result_json or b"{}")
-                if isinstance(result, dict):
-                    remote_spans = result.pop("_trace_spans", None)
-                    if remote_spans and _cur is not None and \
-                            isinstance(remote_spans, list):
-                        # Peer-supplied subtrees are untrusted input:
-                        # prune to the local depth/fan-out/size bounds
-                        # before they enter the trace ring.
-                        from ..obs.span import sanitize_remote
-                        for s in remote_spans[:8]:
-                            sc = sanitize_remote(s)
-                            if sc is not None:
-                                _cur.add_child(sc)
-                return result, data
-            except (OSError, http.client.HTTPException, ValueError) as e:
-                conn.close()
-                if (reused and resp is None and isinstance(
-                        e, (http.client.RemoteDisconnected,
-                            ConnectionResetError, BrokenPipeError))):
-                    # A stale pooled socket (peer restarted): the error
-                    # arrived BEFORE any response started, on a reused
-                    # keep-alive connection — the signature of a dead
-                    # pool, not a dead peer. Retry ONCE on a fresh
-                    # socket; errors after a response began (or any
-                    # error on a fresh socket) never retry, so an RPC
-                    # the peer may have executed is never re-sent.
-                    self._drop_pool()
-                    conn, reused = self._get_conn(eff_timeout)
-                    continue
-                if ddl is not None and ddl.expired():
-                    # The request DEADLINE elapsed, not the peer: the
-                    # socket timeout above was deadline-capped, so say
-                    # nothing about peer health — no offline mark, no
-                    # dynamic-timeout tuning.
-                    record_expiry("rpc-client")
-                    raise DeadlineExceeded(
-                        f"{service}/{method} to {self.endpoint()}: "
-                        f"deadline expired mid-call: {e}")
-                # Only genuine ceiling hits tune the timeout up — an
-                # instant connection-refused says nothing about
-                # slowness.
-                if not logged and isinstance(e, (TimeoutError,
-                                                 socket.timeout)):
-                    self.dyn_timeout.log_failure()
-                if not override:
-                    self._mark_offline()
-                raise serr.DiskNotFound(
-                    f"{self.endpoint()} unreachable: {e}")
+                    result = json.loads(result_json or b"{}")
+                    if isinstance(result, dict):
+                        remote_spans = result.pop("_trace_spans", None)
+                        if remote_spans and _cur is not None and \
+                                isinstance(remote_spans, list):
+                            # Peer-supplied subtrees are untrusted
+                            # input: prune to the local depth/fan-out/
+                            # size bounds before they enter the trace
+                            # ring.
+                            from ..obs.span import sanitize_remote
+                            for s in remote_spans[:8]:
+                                sc = sanitize_remote(s)
+                                if sc is not None:
+                                    _cur.add_child(sc)
+                    return result, data
+                except (OSError, http.client.HTTPException,
+                        ValueError) as e:
+                    conn.close()
+                    if (reused and resp is None and isinstance(
+                            e, (http.client.RemoteDisconnected,
+                                ConnectionResetError,
+                                BrokenPipeError))):
+                        # A stale pooled socket (peer restarted): the
+                        # error arrived BEFORE any response started, on
+                        # a reused keep-alive connection — the
+                        # signature of a dead pool, not a dead peer.
+                        # Retry ONCE on a fresh socket; errors after a
+                        # response began (or any error on a fresh
+                        # socket) never retry, so an RPC the peer may
+                        # have executed is never re-sent.
+                        self._drop_pool()
+                        conn, reused = self._get_conn(eff_timeout)
+                        continue
+                    if ddl is not None and ddl.expired():
+                        # The request DEADLINE elapsed, not the peer:
+                        # the socket timeout above was deadline-capped,
+                        # so say nothing about peer health — no offline
+                        # mark, no dynamic-timeout tuning.
+                        record_expiry("rpc-client")
+                        raise DeadlineExceeded(
+                            f"{service}/{method} to {self.endpoint()}: "
+                            f"deadline expired mid-call: {e}")
+                    # Only genuine ceiling hits tune the timeout up —
+                    # an instant connection-refused says nothing about
+                    # slowness.
+                    if not logged and isinstance(e, (TimeoutError,
+                                                     socket.timeout)):
+                        self.dyn_timeout.log_failure()
+                    if not override:
+                        self._mark_offline()
+                    raise serr.DiskNotFound(
+                        f"{self.endpoint()} unreachable: {e}")
+        finally:
+            CENSUS.exit()
 
     def close(self) -> None:
         with self._mu:
             for c in self._pool:
                 c.close()
             self._pool.clear()
+        from . import aio
+        aio.close_client(self)
 
 
 class RPCRegistry:

@@ -77,6 +77,10 @@ BRIDGE_LOW_WATER = 128 * 1024
 PIPELINE_BUF_MAX = 1 * 1024 * 1024
 # A request head larger than this is an attack or a bug.
 MAX_HEAD_BYTES = 64 * 1024
+# Chunked requests that are NOT streamed object PUTs (sub-resource
+# writes, POSTs) buffer to completion like their Content-Length twins;
+# with no declared length this cap is what bounds them.
+CHUNKED_BUF_MAX = 64 * 1024 * 1024
 # Same stall deadline the threaded server's socket timeout enforced.
 STALL_TIMEOUT_S = 120.0
 # Idle keep-alive reaper period (sweep granularity, not precision).
@@ -101,6 +105,8 @@ class BodyBridge:
 
     def __init__(self, conn: "_HttpConn", length: int,
                  expect_continue: bool):
+        """length < 0 means UNKNOWN (chunked Transfer-Encoding): EOF is
+        decoder-driven via finish() instead of a byte countdown."""
         self._conn = conn
         self.length = length
         self.expect = expect_continue
@@ -125,7 +131,7 @@ class BodyBridge:
             self._chunks.append(data)
             self._buffered += len(data)
             self.received += len(data)
-            if self.received >= self.length:
+            if 0 <= self.length <= self.received:
                 self._eof = True
             pause = self._buffered >= BRIDGE_HIGH_WATER
             if pause:
@@ -147,9 +153,24 @@ class BodyBridge:
         """A body byte arrived, or we solicited one with a 100."""
         return self.started or self.continue_requested
 
+    def finish(self) -> None:
+        """Chunked bodies: the loop-side decoder saw the terminal
+        chunk — every wire byte of this body has been fed (the
+        length countdown in feed() cannot apply when length < 0)."""
+        with self._cv:
+            self._eof = True
+            self._cv.notify_all()
+
     def unread(self) -> int:
         """Body bytes the worker has not consumed (buffered or still
         on the wire)."""
+        if self.length < 0:
+            # Chunked: either the wire framing completed (reuse-safe —
+            # a buffered-but-unconsumed remainder dies with the bridge,
+            # the socket stream itself is clean) or the remainder is
+            # unknowable and the connection must close.
+            with self._cv:
+                return 0 if self._eof else (1 << 30)
         return max(0, self.length - self._consumed)
 
     def read(self, n: int) -> bytes:
@@ -201,6 +222,105 @@ class BodyBridge:
                 self._cv.wait(min(left, 5.0))
 
 
+class _ChunkedTooLarge(ValueError):
+    """Decoded chunked body exceeded the caller's cap."""
+
+
+class _ChunkedTEParser:
+    """Incremental HTTP/1.1 chunked Transfer-Encoding decoder (loop
+    side): feed() takes wire bytes and returns zero-copy memoryview
+    slices of the DECODED payload (the views alias the fed ``bytes``
+    object, so no copy happens until a consumer materializes one).
+
+    Raises ValueError on framing violations and _ChunkedTooLarge when
+    the decoded size passes ``max_decoded`` — an unbounded chunked
+    upload must not get unbounded buffering just because it never
+    declared a Content-Length."""
+
+    MAX_LINE = 8192          # size-line bytes (hex size + extensions)
+    MAX_TRAILER = 16 * 1024  # total trailer-section bytes
+
+    def __init__(self, max_decoded: int):
+        self._max = max_decoded
+        self._line = bytearray()   # partial size/trailer line
+        self._state = "size"       # size | data | data_end | trailer
+        self._left = 0             # payload bytes still owed this chunk
+        self._end_cr = False       # saw the CR of a chunk's CRLF tail
+        self._trailer_len = 0
+        self.decoded = 0
+        self.done = False
+
+    def feed(self, data: bytes) -> tuple[list, bytes]:
+        """-> (decoded_slices, leftover): leftover is the wire tail
+        past the terminal CRLF (the next pipelined request's bytes),
+        always b"" until ``done``."""
+        out: list = []
+        mv = memoryview(data)
+        i, n = 0, len(data)
+        while i < n and not self.done:
+            if self._state == "size":
+                nl = data.find(b"\n", i)
+                if nl < 0:
+                    self._line += data[i:]
+                    if len(self._line) > self.MAX_LINE:
+                        raise ValueError("chunk size line too long")
+                    return out, b""
+                self._line += data[i:nl]
+                i = nl + 1
+                line = bytes(self._line).strip()
+                self._line.clear()
+                if len(line) > self.MAX_LINE:
+                    raise ValueError("chunk size line too long")
+                size_s = line.split(b";", 1)[0].strip()
+                if not size_s:
+                    raise ValueError("empty chunk size")
+                size = int(size_s, 16)  # ValueError on junk
+                if size == 0:
+                    self._state = "trailer"
+                else:
+                    if self.decoded + size > self._max:
+                        raise _ChunkedTooLarge(
+                            "chunked body exceeds cap")
+                    self._left = size
+                    self._state = "data"
+            elif self._state == "data":
+                take = min(self._left, n - i)
+                out.append(mv[i:i + take])
+                self.decoded += take
+                self._left -= take
+                i += take
+                if self._left == 0:
+                    self._state = "data_end"
+            elif self._state == "data_end":
+                c = data[i]
+                i += 1
+                if c == 0x0A:
+                    self._end_cr = False
+                    self._state = "size"
+                elif c == 0x0D and not self._end_cr:
+                    self._end_cr = True
+                else:
+                    raise ValueError("bad chunk data terminator")
+            else:  # trailer
+                nl = data.find(b"\n", i)
+                if nl < 0:
+                    self._line += data[i:]
+                    self._bound_trailer(n - i)
+                    return out, b""
+                line = bytes(self._line) + data[i:nl]
+                self._bound_trailer(nl + 1 - i)
+                self._line.clear()
+                i = nl + 1
+                if not line.strip():
+                    self.done = True
+        return out, bytes(data[i:]) if self.done else b""
+
+    def _bound_trailer(self, grew: int) -> None:
+        self._trailer_len += grew
+        if self._trailer_len > self.MAX_TRAILER:
+            raise ValueError("chunked trailer too large")
+
+
 class _AsyncTxn:
     """The transport adapter ``S3Server._serve_one`` drives for one
     request on an async connection.  Writes are threadsafe enqueues to
@@ -219,8 +339,8 @@ class _AsyncTxn:
         self.headers = headers
         self.body = body
         self.body_stream = body_stream
-        self.content_length = content_length
-        self.rx_length = content_length
+        self.content_length = content_length  # -1 = chunked (unknown)
+        self.rx_length = max(content_length, 0)
         self.client_ip = conn.client_ip
         self.close_after = False
         self.detached = False
@@ -340,6 +460,8 @@ class _HttpConn(asyncio.Protocol):
         self._need = 0                # buffered-body bytes still wanted
         self._bridge: BodyBridge | None = None
         self._body_left = 0           # wire bytes of the current body
+        self._chunked: _ChunkedTEParser | None = None
+        self._chunk_acc: bytearray | None = None  # buffered-mode body
         self._discard_left = 0        # post-response tail to discard
         self._continue_sent = False
         self._closed = False
@@ -414,6 +536,9 @@ class _HttpConn(asyncio.Protocol):
                 return
             data = data[self._discard_left:]
             self._discard_left = 0
+        if self._chunked is not None:
+            self._feed_chunked(data)
+            return
         if self._body_left > 0 and self._bridge is not None:
             if len(data) <= self._body_left:
                 self._body_left -= len(data)
@@ -435,6 +560,16 @@ class _HttpConn(asyncio.Protocol):
             self.transport.pause_reading()
 
     def eof_received(self):
+        if self._chunked is not None:
+            # Torn mid-chunk: a streamed PUT's reader gets the error
+            # (its worker answers and releases the slot); a buffered
+            # chunked request never dispatched — just close.
+            self._chunked = None
+            self._chunk_acc = None
+            if self._bridge is not None:
+                self._bridge.fail(ConnectionResetError(
+                    "client half-closed mid-body"))
+            return False
         if self._bridge is not None and self._body_left > 0:
             self._bridge.fail(ConnectionResetError(
                 "client half-closed mid-body"))
@@ -510,11 +645,22 @@ class _HttpConn(asyncio.Protocol):
         except ValueError:
             self._reject(400, "bad Content-Length")
             return False
-        if headers.get("transfer-encoding", "").lower() == "chunked":
-            # Same posture as the threaded front end (which only ever
-            # read Content-Length bodies): S3 clients frame uploads
-            # with Content-Length (aws-chunked rides inside it).
-            self._reject(501, "chunked transfer encoding unsupported")
+        te = headers.get("transfer-encoding", "").strip().lower()
+        chunked = te == "chunked"
+        if te and not chunked:
+            # Only the terminal "chunked" coding is implemented (what
+            # real SDKs send; gzip'd request bodies are not a thing S3
+            # clients do).
+            self._reject(501, f"transfer encoding {te} unsupported")
+            return False
+        if chunked and "content-length" in headers:
+            # RFC 7230 §3.3.3: a message with both is a smuggling
+            # vector — never guess, reject.
+            self._reject(400, "both Content-Length and "
+                              "Transfer-Encoding")
+            return False
+        if chunked and version == "HTTP/1.0":
+            self._reject(400, "chunked framing requires HTTP/1.1")
             return False
         if version == "HTTP/1.0" and \
                 headers.get("connection", "").lower() != "keep-alive":
@@ -524,6 +670,9 @@ class _HttpConn(asyncio.Protocol):
         expect = "100-continue" in headers.get("expect", "").lower()
         server = self.front.server
         is_s3 = not raw_path.startswith("/minio-tpu/")
+        if chunked:
+            return self._begin_chunked(method, raw_path, query,
+                                       headers, expect, is_s3)
         # Bridge (stream) only object PUTs: large ones like the
         # threaded path, plus ANY carrying Expect (admission must run
         # before the upload). Everything else — STS POSTs, multipart
@@ -558,6 +707,83 @@ class _HttpConn(asyncio.Protocol):
             return True
         self._dispatch(method, raw_path, query, headers, b"", None, 0)
         return True
+
+    def _begin_chunked(self, method: str, raw_path: str, query: str,
+                       headers: dict, expect: bool, is_s3: bool) -> bool:
+        """Set up chunked-body decode. Object PUTs stream through the
+        BodyBridge with length -1 (the decoder drives EOF) straight
+        into the erasure pipeline — the zero-copy path real SDKs'
+        streaming-SigV4 uploads take. Everything else buffers the
+        decoded body to completion (capped) and dispatches exactly
+        like a Content-Length request."""
+        stream = (is_s3 and method == "PUT"
+                  and "/" in raw_path.lstrip("/"))
+        if stream:
+            from .server import MAX_OBJECT_SIZE
+            self._chunked = _ChunkedTEParser(MAX_OBJECT_SIZE + 1)
+            self._chunk_acc = None
+            self._bridge = BodyBridge(self, -1, expect)
+            self._continue_sent = False
+            self._dispatch(method, raw_path, query, headers, b"",
+                           self._bridge, -1)
+        else:
+            if expect:
+                self._send_continue()
+            self._chunked = _ChunkedTEParser(CHUNKED_BUF_MAX)
+            self._chunk_acc = bytearray()
+            self._head = (method, raw_path, query, headers, -1)
+            self._state = "chunk"
+        if self._buf:
+            # Bytes the client sent behind the head feed through.
+            data0 = bytes(self._buf)
+            self._buf.clear()
+            self._feed_chunked(data0)
+        return True
+
+    def _feed_chunked(self, data: bytes) -> None:
+        """Run wire bytes through the chunked decoder (loop thread)."""
+        parser = self._chunked
+        try:
+            slices, leftover = parser.feed(data)
+        except ValueError as e:
+            self._chunked = None
+            if self._chunk_acc is not None or self._bridge is None:
+                # Nothing dispatched yet: protocol-level reject.
+                self._chunk_acc = None
+                status = 413 if isinstance(e, _ChunkedTooLarge) else 400
+                self._reject(status, f"bad chunked framing: {e}")
+            else:
+                # A streamed PUT is mid-flight: fail its body reader
+                # (the worker answers the error and releases its slot)
+                # and stop trusting this connection's framing.
+                self._bridge.fail(e)
+                self._draining = True
+            return
+        if self._chunk_acc is not None:
+            for piece in slices:
+                self._chunk_acc += piece
+        elif self._bridge is not None:
+            pause = False
+            for piece in slices:
+                # memoryview slices of `data`: the bridge consumer
+                # materializes exactly once, on read.
+                if self._bridge.feed(piece):
+                    pause = True
+            if pause and not self._rx_paused:
+                self._rx_paused = True
+                self.transport.pause_reading()
+        if parser.done:
+            self._chunked = None
+            if leftover:
+                self._buf += leftover  # next pipelined request
+            if self._chunk_acc is not None:
+                body = bytes(self._chunk_acc)
+                self._chunk_acc = None
+                method, raw_path, query, headers, _cl = self._head
+                self._dispatch(method, raw_path, query, headers, body,
+                               None, len(body))
+            elif self._bridge is not None:
+                self._bridge.finish()
 
     def _reject(self, status: int, why: str) -> None:
         """Protocol-level error: answer (when possible) and close."""
@@ -700,7 +926,15 @@ class _HttpConn(asyncio.Protocol):
             # already fed the bridge left the socket stream, so only
             # the un-received remainder threatens the framing.
             tail = self._body_left
+            if self._bridge.length < 0 and self._bridge.unread() > 0:
+                # Chunked body not fully framed: the remainder is
+                # unknowable, so the only safe exit is the lingering
+                # close below (prepare_body_cleanup already forced
+                # Connection: close for this case).
+                tail = max(tail, 1)
             self._bridge = None
+        self._chunked = None
+        self._chunk_acc = None
         self._body_left = 0
         if self._peer_eof and (tail > 0 or not self._buf):
             # The peer already half-closed and nothing of use remains:
@@ -916,6 +1150,8 @@ class AsyncFrontDoor:
         self._threads: list[threading.Thread] = []
         self._tasks: list = []
         self._lsock: socket.socket | None = None
+        self._lsocks: list[socket.socket] = []  # SO_REUSEPORT, per loop
+        self.reuseport = False
         self._mu = threading.Lock()
         self._conns: set[_HttpConn] = set()
         self._accept_pending = 0
@@ -926,13 +1162,56 @@ class AsyncFrontDoor:
     # -- lifecycle ------------------------------------------------------
 
     def start(self, host: str, port: int) -> int:
+        import os
         raise_nofile_limit()
-        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._lsock.bind((host, port))
-        self._lsock.listen(1024)
-        self._lsock.setblocking(False)
-        bound = self._lsock.getsockname()[1]
+        # Multi-loop accept via SO_REUSEPORT: each loop thread owns
+        # its OWN listen socket bound to the same port, so the KERNEL
+        # load-spreads incoming connections across loops — no accept
+        # handoff, no cross-loop self-pipe wakeup per connection.
+        # Falls back to the single-socket round-robin accept loop when
+        # the option is unavailable (or MINIO_REUSEPORT=off).
+        want_reuseport = (
+            hasattr(socket, "SO_REUSEPORT")
+            and os.environ.get("MINIO_REUSEPORT", "on").strip().lower()
+            not in ("off", "0", "no"))
+        if want_reuseport:
+            try:
+                bind_port = port
+                for _ in range(self._n_loops):
+                    s = socket.socket(socket.AF_INET,
+                                      socket.SOCK_STREAM)
+                    try:
+                        s.setsockopt(socket.SOL_SOCKET,
+                                     socket.SO_REUSEADDR, 1)
+                        s.setsockopt(socket.SOL_SOCKET,
+                                     socket.SO_REUSEPORT, 1)
+                        s.bind((host, bind_port))
+                        s.listen(1024)
+                        s.setblocking(False)
+                    except OSError:
+                        s.close()
+                        raise
+                    self._lsocks.append(s)
+                    # port 0: later sockets join the resolved port.
+                    bind_port = self._lsocks[0].getsockname()[1]
+            except OSError:
+                for s in self._lsocks:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+                self._lsocks = []
+        self.reuseport = bool(self._lsocks)
+        if not self._lsocks:
+            self._lsock = socket.socket(socket.AF_INET,
+                                        socket.SOCK_STREAM)
+            self._lsock.setsockopt(socket.SOL_SOCKET,
+                                   socket.SO_REUSEADDR, 1)
+            self._lsock.bind((host, port))
+            self._lsock.listen(1024)
+            self._lsock.setblocking(False)
+        bound = (self._lsocks[0] if self._lsocks
+                 else self._lsock).getsockname()[1]
         self._running = True
         ready = threading.Barrier(self._n_loops + 1)
         for i in range(self._n_loops):
@@ -945,8 +1224,13 @@ class AsyncFrontDoor:
             t.start()
             self._threads.append(t)
         ready.wait(timeout=10)
-        # Loop 0 owns accept; connections spread round-robin.
-        self._call_on(0, self._start_accept)
+        if self._lsocks:
+            # Every loop accepts from its own socket into itself.
+            for i in range(self._n_loops):
+                self._call_on(i, self._start_accept_on, i)
+        else:
+            # Loop 0 owns accept; connections spread round-robin.
+            self._call_on(0, self._start_accept)
         for i in range(self._n_loops):
             self._call_on(i, self._start_sweep, self._loops[i])
         return bound
@@ -970,15 +1254,24 @@ class AsyncFrontDoor:
 
     def _start_accept(self) -> None:
         loop = self._loops[0]
-        self.track_task(loop.create_task(self._accept_loop(loop)))
+        self.track_task(loop.create_task(
+            self._accept_loop(loop, self._lsock, pinned=False)))
+
+    def _start_accept_on(self, idx: int) -> None:
+        loop = self._loops[idx]
+        self.track_task(loop.create_task(
+            self._accept_loop(loop, self._lsocks[idx], pinned=True)))
 
     def _start_sweep(self, loop) -> None:
         self.track_task(loop.create_task(self._sweep_loop(loop)))
 
-    async def _accept_loop(self, loop) -> None:
+    async def _accept_loop(self, loop, lsock, pinned: bool) -> None:
+        """`pinned`: SO_REUSEPORT mode — this loop owns `lsock` and
+        every connection it accepts; otherwise the single listener
+        round-robins accepted sockets across all loops."""
         while self._running:
             try:
-                sock, _addr = await loop.sock_accept(self._lsock)
+                sock, _addr = await loop.sock_accept(lsock)
             except asyncio.CancelledError:
                 break
             except OSError as e:
@@ -1001,6 +1294,11 @@ class AsyncFrontDoor:
                 self._accepted_total += 1
             _metrics().inc("minio_tpu_v2_connections_accepted_total")
             self._publish_gauges()
+            if pinned:
+                # The kernel already picked this loop: establish
+                # in-place, zero handoff.
+                loop.create_task(self._establish(sock, loop))
+                continue
             target = self._loops[self._next_loop % self._n_loops]
             self._next_loop += 1
             if target is loop:
@@ -1129,11 +1427,14 @@ class AsyncFrontDoor:
         finish within ``drain_s``, then abort stragglers and stop the
         loops."""
         self._running = False
-        if self._lsock is not None:
+        for s in [*self._lsocks, self._lsock]:
+            if s is None:
+                continue
             try:
-                self._lsock.close()
+                s.close()
             except OSError:
                 pass
+        self._lsocks = []
         # Close idle connections now; flag busy ones to close on
         # response completion.
         with self._mu:

@@ -4149,12 +4149,34 @@ class S3Server:
             def log_message(self, *args):  # silence
                 pass
 
+            def _reject(self, status: int, msg: str):
+                """Pre-dispatch framing error: terse close-delimited
+                response (the request body's extent is unknowable, so
+                keep-alive is off the table)."""
+                self.send_response(status, msg)
+                self.send_header("Content-Length", "0")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                self.close_connection = True
+
             def _handle(self):
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     raw_path, _, query = self.path.partition("?")
                     headers = {k.lower(): v
                                for k, v in self.headers.items()}
+                    te = headers.get("transfer-encoding", "").strip()
+                    if te:
+                        if te.lower() != "chunked":
+                            return self._reject(501, "Not Implemented")
+                        if "content-length" in headers:
+                            # CL + TE together is the classic request
+                            # smuggling vector: refuse outright.
+                            return self._reject(400, "Bad Request")
+                        if self.request_version == "HTTP/1.0":
+                            return self._reject(400, "Bad Request")
+                        return self._handle_chunked(
+                            raw_path, query, headers)
                     # Large object PUTs stream: the socket body is never
                     # buffered whole (ref the reference's streaming PUT
                     # pipeline, cmd/erasure-encode.go:73).
@@ -4175,6 +4197,44 @@ class S3Server:
                     server._serve_one(txn)
                 except (BrokenPipeError, ConnectionResetError):
                     pass
+
+            def _handle_chunked(self, raw_path, query, headers):
+                """Chunked Transfer-Encoding request body: object PUTs
+                stream the decoder straight into the erasure pipeline
+                (length -1 = unknown); everything else decodes to a
+                buffer first — same split as the async front door
+                (`asyncserver._HttpConn._begin_chunked`)."""
+                from .asyncserver import CHUNKED_BUF_MAX
+                from ..utils.streams import (ChunkedTEReader,
+                                             ChunkedTooLarge)
+                stream_body = (
+                    self.command == "PUT"
+                    and not raw_path.startswith("/minio-tpu/")
+                    and "/" in raw_path.lstrip("/"))
+                if stream_body:
+                    body = b""
+                    body_stream = ChunkedTEReader(
+                        self.rfile, MAX_OBJECT_SIZE + 1)
+                    length = -1
+                else:
+                    reader = ChunkedTEReader(self.rfile, CHUNKED_BUF_MAX)
+                    acc = bytearray()
+                    try:
+                        while True:
+                            piece = reader.read(64 * 1024)
+                            if not piece:
+                                break
+                            acc += piece
+                    except ChunkedTooLarge:
+                        return self._reject(413, "Payload Too Large")
+                    except ValueError:
+                        return self._reject(400, "Bad Request")
+                    body = bytes(acc)
+                    body_stream = None
+                    length = len(body)
+                txn = _ThreadedTxn(self, raw_path, query, headers,
+                                   body, body_stream, length)
+                server._serve_one(txn)
 
             def do_OPTIONS(self):
                 """CORS preflight: unauthenticated by design (ref the
@@ -4310,8 +4370,8 @@ class _ThreadedTxn:
         self.headers = headers
         self.body = body
         self.body_stream = body_stream  # raw LimitReader (or None)
-        self.content_length = length
-        self.rx_length = length
+        self.content_length = length  # -1 = chunked (unknown)
+        self.rx_length = max(length, 0)
         self.client_ip = handler.client_address[0]
         self.close_after = False
         self.detached = False

@@ -584,6 +584,33 @@ def test_r8_nested_async_def_checked():
     assert len(found) == 1 and "lock acquire" in found[0].message
 
 
+def test_r8_covers_rpc_package():
+    """PR-18 fabric: the async RPC loop (rpc/aio.py) has the same
+    one-blocking-call-stalls-everything failure mode as the front
+    door — R8 must patrol minio_tpu/rpc/ too."""
+    src = (
+        "import time\n"
+        "async def roundtrip(conn, lock):\n"
+        "    lock.acquire()\n"
+        "    time.sleep(0.1)\n"
+        "    conn.sendall(b'frame')\n")
+    found = _check(AsyncBlockingRule(), src,
+                   "minio_tpu/rpc/sample.py")
+    assert len(found) == 3, found
+
+
+def test_r8_rpc_package_awaited_calls_exempt():
+    src = (
+        "import asyncio\n"
+        "async def exchange(writer, reader, rlock):\n"
+        "    writer.write(b'frame')\n"
+        "    await writer.drain()\n"
+        "    async with rlock:\n"
+        "        return await asyncio.wait_for(reader.readexactly(4), 5)\n")
+    assert _check(AsyncBlockingRule(), src,
+                  "minio_tpu/rpc/sample.py") == []
+
+
 def test_r8_scoped_to_s3_package_with_waiver_escape():
     src = (
         "import time\n"
@@ -592,6 +619,7 @@ def test_r8_scoped_to_s3_package_with_waiver_escape():
     rule = AsyncBlockingRule()
     assert not rule.applies(_ctx(src, "minio_tpu/erasure/sample.py"))
     assert not rule.applies(_ctx(src, "tools/sample.py"))
+    assert rule.applies(_ctx(src, "minio_tpu/rpc/sample.py"))
     waived = (
         "import time\n"
         "async def f():\n"

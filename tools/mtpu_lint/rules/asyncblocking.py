@@ -1,12 +1,15 @@
 """R8 — no blocking calls inside ``async def`` bodies under
-``minio_tpu/s3/``.
+``minio_tpu/s3/`` and ``minio_tpu/rpc/``.
 
 The async front door (``s3/asyncserver.py``) runs accept/parse/
-keep-alive for 10k+ sockets on a handful of event-loop threads; ONE
-blocking call in a coroutine stalls every connection on that loop.
-The architecture keeps all blocking work on the worker pool (request
-execution) or behind ``run_in_executor`` (streaming-response chunk
-pulls) — this rule makes a regression of that boundary a lint failure.
+keep-alive for 10k+ sockets on a handful of event-loop threads, and
+the async RPC fabric (``rpc/aio.py``) multiplexes every internal peer
+call over ONE shared loop thread; a single blocking call in a
+coroutine stalls every connection (or every in-flight peer RPC) on
+that loop.  The architecture keeps all blocking work on the worker
+pool (request execution) or behind ``run_in_executor`` (streaming-
+response chunk pulls) — this rule makes a regression of that boundary
+a lint failure.
 
 Flagged inside ``async def`` bodies (nested sync ``def``s are skipped —
 they run on whatever thread calls them, which the loop must not):
@@ -60,10 +63,12 @@ _BLOCKING_DOTTED = {
 class AsyncBlockingRule(Rule):
     id = "R8"
     title = ("no blocking calls (socket I/O, time.sleep, lock acquire, "
-             "file I/O) inside async def bodies under minio_tpu/s3/")
+             "file I/O) inside async def bodies under minio_tpu/s3/ "
+             "and minio_tpu/rpc/")
 
     def applies(self, ctx) -> bool:
-        return ctx.relpath.startswith("minio_tpu/s3/")
+        return ctx.relpath.startswith(("minio_tpu/s3/",
+                                       "minio_tpu/rpc/"))
 
     def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
         self._walk_async_body(node)

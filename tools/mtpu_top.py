@@ -154,6 +154,11 @@ def render(doc: dict, width: int = 60) -> str:
         f"conns: open {_num(last.get('conns', 0))}  "
         f"accept-queue {_num(last.get('acceptQueue', 0))}  "
         f"parse-err/s {_num(last.get('parseErrors', 0) / dt(last))}")
+    # Internal RPC fabric: peer calls in flight vs process threads —
+    # inflight >> threads means the async fabric is doing its job.
+    lines.append(
+        f"rpc: inflight {_num(last.get('rpcInflight', 0))}  "
+        f"threads {_num(last.get('threads', 0))}")
     # Hot-object cache row: hit ratio over the last window + resident
     # bytes (the serving tier's live effectiveness at a glance).
     ch = last.get("cacheHits", 0)
