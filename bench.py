@@ -1210,6 +1210,146 @@ def bench_noisy_neighbor(np, workdir: str) -> dict:
             shutil.rmtree(base, ignore_errors=True)
 
 
+def bench_loop_health(np, workdir: str) -> dict:
+    """Event-loop health plane end-to-end (obs/loopmon.py), two
+    promises:
+
+    1. a paired loopmon-on/off keep-alive PUT p50 within the repo's
+       2% noise bar — a 10Hz heartbeat + watcher must be free on the
+       hot path;
+    2. an injected 400ms ``loop_block`` fault plan against a
+       front-door loop drives the ``loop_stall`` watchdog built-in to
+       firing with the blamed frame (``_injected_loop_block``) named
+       in the cause, and the alert resolves after the plan clears and
+       the recent-stall window drains.
+    """
+    import statistics as stats
+
+    from minio_tpu.erasure.engine import ErasureObjects
+    from minio_tpu.obs.loopmon import LOOPMON
+    from minio_tpu.obs.metrics2 import METRICS2
+    from minio_tpu.obs.watchdog import WATCHDOG
+    from minio_tpu.s3.admin_client import AdminClient
+    from minio_tpu.s3.client import S3Client
+    from minio_tpu.s3.server import S3Server
+    from minio_tpu.storage.xl import XLStorage
+
+    access, secret = "benchadmin", "benchadmin-secret"
+    base = workdir
+    if os.path.isdir("/dev/shm"):
+        # tmpfs like put_p50: the paired p50 tracks the heartbeat's
+        # CPU cost, not VM writeback noise.
+        base = tempfile.mkdtemp(prefix="minio-tpu-loop-",
+                                dir="/dev/shm")
+    root = os.path.join(base, "cfg-loop")
+    disks = [XLStorage(os.path.join(root, f"disk{i}"))
+             for i in range(6)]
+    layer = ErasureObjects(disks, 4, 2, block_size=1024 * 1024)
+    srv = S3Server(layer, access, secret)
+    port = srv.start()
+    try:
+        client = S3Client("127.0.0.1", port, access, secret)
+        adm = AdminClient("127.0.0.1", port, access, secret)
+        client.make_bucket("lhealth")
+        rng = np.random.default_rng(19)
+        body = rng.integers(0, 256, 1024 * 1024).astype(
+            np.uint8).tobytes()
+        for i in range(4):  # warm compile/caches
+            client.put_object("lhealth", f"warm-{i}", body)
+
+        # -- paired loopmon-on/off PUT p50 (off/on/off brackets drift)
+        def put_lat(tag: str, n: int = 24) -> list[float]:
+            lat = []
+            for i in range(n):
+                t0 = time.perf_counter()
+                r = client.put_object("lhealth", f"{tag}-{i}", body)
+                lat.append(time.perf_counter() - t0)
+                if r.status != 200:
+                    raise RuntimeError(f"PUT failed: {r.status}")
+            return lat
+
+        LOOPMON.set_enabled(False)
+        lat_off = put_lat("off1")
+        LOOPMON.set_enabled(True)
+        lat_on = put_lat("on")
+        LOOPMON.set_enabled(False)
+        lat_off += put_lat("off2")
+        LOOPMON.set_enabled(True)
+        p50_off = stats.median(lat_off) * 1e3
+        p50_on = stats.median(lat_on) * 1e3
+        overhead_pct = (p50_on - p50_off) / max(p50_off, 1e-9) * 100
+        if overhead_pct > 2.0:
+            raise RuntimeError(
+                f"loopmon-on PUT p50 overhead {overhead_pct:.2f}% "
+                f"exceeds the 2% noise bar "
+                f"(on {p50_on:.3f}ms vs off {p50_off:.3f}ms)")
+
+        # -- injected 400ms loop_block -> loop_stall fires -> resolves
+        adm.set_config_kv("obs timeline_sample=250ms "
+                          "loop_stall_ms=200")
+        adm.set_config_kv("alerts pending_ticks=2 resolve_ticks=2")
+        fired_before = METRICS2.get(
+            "minio_tpu_v2_alert_transitions_total",
+            {"rule": "loop_stall", "state": "firing"}) or 0
+        # ONE deterministic block on the first front-door loop: the
+        # heartbeat schedules it as a real time.sleep on the loop.
+        adm.fault_inject({"seed": 19, "rules": [
+            {"kind": "loop_block", "target": "s3-0",
+             "latency_ms": 400, "count": 1}]})
+        fire_deadline = time.time() + 20
+        while (time.time() < fire_deadline
+               and (METRICS2.get(
+                   "minio_tpu_v2_alert_transitions_total",
+                   {"rule": "loop_stall", "state": "firing"})
+                   or 0) <= fired_before):
+            time.sleep(0.25)
+        fired = (METRICS2.get(
+            "minio_tpu_v2_alert_transitions_total",
+            {"rule": "loop_stall", "state": "firing"})
+            or 0) - fired_before
+        snap_alerts = {a["rule"]: a for a in
+                       WATCHDOG.snapshot()["alerts"]}
+        cause = snap_alerts.get("loop_stall", {}).get("cause", "")
+        if fired < 1 or "_injected_loop_block" not in cause:
+            raise RuntimeError(
+                "loop_stall never fired naming the injected frame "
+                f"(fired={fired}, cause={cause!r}, "
+                f"stalls={LOOPMON.snapshot()['stalls'][-3:]})")
+
+        adm.fault_inject(clear=True)
+        # The recent-stall window (10s) drains, then resolve_ticks.
+        resolve_deadline = time.time() + 40
+        while (time.time() < resolve_deadline
+               and WATCHDOG.state_of("loop_stall") != "ok"):
+            time.sleep(0.25)
+        if WATCHDOG.state_of("loop_stall") != "ok":
+            raise RuntimeError(
+                "loop_stall never resolved after the plan cleared: "
+                f"{WATCHDOG.snapshot()['alerts']}")
+
+        prof = LOOPMON.profiler.report(top=5, minutes=2)
+        return {
+            "metric": "loop_health",
+            "value": round(overhead_pct, 2),
+            "unit": "loopmon_on_p50_overhead_pct",
+            "put_p50_loopmon_on_ms": round(p50_on, 3),
+            "put_p50_loopmon_off_ms": round(p50_off, 3),
+            "alert_fired": fired, "alert_cause": cause,
+            "alert_resolved": True,
+            "loop_census": LOOPMON.lag_census(),
+            "profiler_running": prof["running"],
+            "profiler_samples": prof["samples"],
+        }
+    finally:
+        from minio_tpu.faultinject import FAULTS
+        FAULTS.clear()
+        LOOPMON.set_enabled(True)
+        srv.stop()
+        shutil.rmtree(root, ignore_errors=True)
+        if base != workdir:
+            shutil.rmtree(base, ignore_errors=True)
+
+
 # --- config 9: crash recovery — kill -9 mid-PUT-loop, restart, recover -------
 
 
@@ -2314,6 +2454,8 @@ def main() -> None:
                       lambda: bench_noisy_neighbor(np, workdir)),
                      ("front_door",
                       lambda: bench_front_door(np, workdir)),
+                     ("loop_health",
+                      lambda: bench_loop_health(np, workdir)),
                      ("crash_recovery",
                       lambda: bench_crash_recovery(np, workdir)),
                      ("select_scan",

@@ -188,6 +188,13 @@ DEFAULT_KVS: dict[str, dict[str, str]] = {
         # memory (the ring is capacity-clamped; see MAX_SAMPLES).
         "timeline_sample": "1s",
         "timeline_retention": "15m",
+        # Event-loop health plane (obs/loopmon.py): a heartbeat
+        # overdue past `loop_stall_ms` triggers the stall flight
+        # recorder (stack capture + watchdog loop_stall rule);
+        # `profile_continuous` keeps the ~1% duty-cycle whole-process
+        # profiler running (admin /profile).
+        "loop_stall_ms": "250",
+        "profile_continuous": "on",
     },
 }
 

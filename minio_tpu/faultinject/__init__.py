@@ -19,6 +19,7 @@ share ONE plan with ONE deterministic decision procedure:
         {"kind": "slow_wire",  "target": "10.0.0.2:9000",
          "latency_ms": 30},
         {"kind": "kernel", "target": "rs_encode"},
+        {"kind": "loop_block", "target": "s3-0", "latency_ms": 400},
     ]}
 
 Rule fields: ``kind`` (required), ``target`` (substring matched against
@@ -57,7 +58,10 @@ Hook points (each a one-attribute check when no plan is loaded):
     (partition, slow_wire); ``rpc/storage.py`` read results ->
     :meth:`filter_read` (corrupt over the wire);
   - ``ops/batching.py`` device dispatch -> :meth:`kernel`
-    (kernel-dispatch failure; exercises the host-fallback lane).
+    (kernel-dispatch failure; exercises the host-fallback lane);
+  - ``obs/loopmon.py`` heartbeat -> :meth:`loop_block` (deterministic
+    blocking callback on a named event loop; proves the stall
+    detect -> blame -> fire -> resolve chain).
 
 Configured via the admin API (``/minio-tpu/admin/v1/fault-inject``)
 or config-KV (``fault_inject enable=on plan=<compact JSON>``).
@@ -72,7 +76,7 @@ import threading
 import time
 
 KINDS = ("latency", "error", "corrupt", "torn_write", "partition",
-         "slow_wire", "kernel", "crash")
+         "slow_wire", "kernel", "crash", "loop_block")
 
 # kinds consulted at each hook
 _DISK_KINDS = ("latency", "error")
@@ -351,6 +355,21 @@ class FaultInjector:
             else:
                 part = True
         return lat, part
+
+    def loop_block(self, loop_name: str) -> float:
+        """Event-loop blocker: seconds the named loop's loopmon
+        heartbeat should schedule as a REAL blocking time.sleep
+        callback onto its own loop (obs/loopmon.py
+        ``_injected_loop_block``) — the deterministic stall that
+        proves the detect -> blame -> fire -> resolve chain.  Returns
+        0.0 with no plan loaded (single attribute read; the hook runs
+        at 10Hz per loop)."""
+        if not self.enabled:
+            return 0.0
+        total = 0.0
+        for r in self._collect(("loop_block",), loop_name):
+            total += r.latency_ms / 1e3
+        return total
 
     def kernel(self, name: str) -> None:
         """Kernel-dispatch failure: raises inside the device dispatch

@@ -133,6 +133,14 @@ class IncidentRecorder:
             from .watchdog import WATCHDOG
             return WATCHDOG.snapshot()
 
+        def loop_census() -> dict:
+            # Event-loop health at capture time: per-loop lag/census
+            # plus the stall flight-recorder ring — for a loop_stall
+            # firing this is the evidence (the frozen stack captures
+            # naming the frame that held the loop).
+            from .loopmon import LOOPMON
+            return LOOPMON.snapshot()
+
         def usage_census() -> dict:
             # The attribution snapshot at capture time: WHO was the
             # traffic when the alert fired — the noisy_neighbor rule's
@@ -148,6 +156,7 @@ class IncidentRecorder:
         section("faultPlan", fault_plan)
         section("alerts", alert_census)
         section("usage", usage_census)
+        section("loops", loop_census)
         for name, provider in list(self.providers.items()):
             section(name, provider)
         if isinstance(bundle.get("config"), dict):
@@ -177,7 +186,7 @@ class IncidentRecorder:
         never re-serializes the ring to report byte counts)."""
         size = len(json.dumps(bundle, default=str))
         for drop in ("worstTrace", "slowlog", "timeline", "usage",
-                     "config"):
+                     "loops", "config"):
             if size <= MAX_BYTES:
                 return size
             if drop in bundle:
@@ -203,8 +212,11 @@ class IncidentRecorder:
         """Newest-last index of captured bundles (id + headline)."""
         with self._mu:
             items = list(self._ring)
-        return [{"id": b["id"], "rule": b["rule"], "cause": b["cause"],
-                 "capturedAt": b["capturedAt"],
+        # ``bundleId`` duplicates ``id`` on purpose: it is the JOIN
+        # KEY the watchdog webhook payloads carry, so an external
+        # pager can match a notification to its bundle field-for-field.
+        return [{"id": b["id"], "bundleId": b["id"], "rule": b["rule"],
+                 "cause": b["cause"], "capturedAt": b["capturedAt"],
                  "bytes": b.get("bytes", 0)}
                 for b in items]
 

@@ -625,3 +625,32 @@ METRICS2.register(
     _OVERFLOW, "counter",
     "Capped-label values folded into _other by the cardinality "
     "guard, by metric and label.")
+# Event-loop health plane (obs/loopmon.py): per-loop scheduling lag,
+# stall flight recorder, pool census and the continuous profiler.
+METRICS2.register(
+    "minio_tpu_v2_loop_lag_ms", "histogram",
+    "Event-loop heartbeat scheduling lag in milliseconds, by loop "
+    "(expected vs actual wake of the 10Hz loopmon heartbeat — the "
+    "runtime twin of lint rule R8).")
+METRICS2.register(
+    "minio_tpu_v2_loop_lag_ewma_ms", "gauge",
+    "EWMA of event-loop scheduling lag in milliseconds, by loop.")
+METRICS2.register(
+    "minio_tpu_v2_loop_tasks", "gauge",
+    "Pending asyncio tasks on each monitored event loop.")
+METRICS2.register(
+    "minio_tpu_v2_loop_stalls_total", "counter",
+    "Stall episodes the loopmon flight recorder captured (heartbeat "
+    "overdue past obs.loop_stall_ms), by loop.")
+METRICS2.register(
+    "minio_tpu_v2_pool_threads", "gauge",
+    "Executor pool size, by pool (worker/rpc/stream) — splits the "
+    "flat process thread count so a stalled loop and an exhausted "
+    "pool are distinguishable.")
+METRICS2.register(
+    "minio_tpu_v2_pool_threads_busy", "gauge",
+    "Executor pool threads currently running work, by pool.")
+METRICS2.register(
+    "minio_tpu_v2_profile_samples_total", "counter",
+    "Thread stack samples taken by the continuous profiler "
+    "(obs/loopmon.py, ~1% duty cycle).")

@@ -189,6 +189,7 @@ class Timeline:
         can feed synthetic counters (reset behavior, merge shapes)."""
         from .drivemon import DRIVEMON
         from .kernprof import KERNPROF
+        from .loopmon import LOOPMON
         from .metrics2 import METRICS2
         snap = METRICS2.snapshot()
 
@@ -245,6 +246,16 @@ class Timeline:
             # zero-thread-per-call claim, visible per node.
             "rpcInflight": _series_sum(m("minio_tpu_v2_rpc_inflight")),
             "threads": threading.active_count(),
+            # Event-loop health census (obs/loopmon.py): per-loop EWMA
+            # scheduling lag + pending tasks, and the flat thread
+            # count split per executor pool — a stalled loop and an
+            # exhausted pool must be distinguishable on the timeline.
+            "loopLag": LOOPMON.lag_census(),
+            "loopTasks": LOOPMON.task_census(),
+            "poolThreads": _series_sum(
+                m("minio_tpu_v2_pool_threads"), by="pool"),
+            "poolBusy": _series_sum(
+                m("minio_tpu_v2_pool_threads_busy"), by="pool"),
             # Analytics scan volume (s3select): decoded bytes +
             # queries, delta'd into a select GiB/s row in mtpu_top.
             "selectProcessed": _series_sum(
@@ -344,6 +355,13 @@ class Timeline:
                                   prev.get("parseErrors", 0)),
                 "rpcInflight": raw.get("rpcInflight", 0),
                 "threads": raw.get("threads", 0),
+                # Event-loop / pool census (gauge-like, not delta'd):
+                # per-loop EWMA lag ms + pending tasks, per-pool
+                # thread size and busy count.
+                "loopLag": dict(raw.get("loopLag") or {}),
+                "loopTasks": dict(raw.get("loopTasks") or {}),
+                "poolThreads": dict(raw.get("poolThreads") or {}),
+                "poolBusy": dict(raw.get("poolBusy") or {}),
                 "selectProcessed": _d(raw.get("selectProcessed", 0),
                                       prev.get("selectProcessed", 0)),
                 "selectRequests": _d(raw.get("selectRequests", 0),
@@ -469,6 +487,11 @@ def _collapse_node(snap: dict, period_s: float) -> list[dict]:
             "parseErrors": 0,
             "rpcInflight": last.get("rpcInflight", 0),
             "threads": last.get("threads", 0),
+            # Census like alerts: the bucket's latest loop/pool state.
+            "loopLag": dict(last.get("loopLag") or {}),
+            "loopTasks": dict(last.get("loopTasks") or {}),
+            "poolThreads": dict(last.get("poolThreads") or {}),
+            "poolBusy": dict(last.get("poolBusy") or {}),
             "mrfDepth": last.get("mrfDepth", 0),
             "mrfJournal": last.get("mrfJournal", 0),
             "drives": dict(last.get("drives") or {}),
@@ -536,6 +559,8 @@ def merge_timelines(snapshots: list[dict],
                     "hedgeFired": 0, "mrfDepth": 0, "mrfJournal": 0,
                     "conns": 0, "acceptQueue": 0, "parseErrors": 0,
                     "rpcInflight": 0, "threads": 0,
+                    "loopLag": {}, "loopTasks": {},
+                    "poolThreads": {}, "poolBusy": {},
                     "resets": 0,
                     "selectProcessed": 0, "selectRequests": 0,
                     "cacheHits": 0, "cacheMisses": 0,
@@ -575,6 +600,15 @@ def merge_timelines(snapshots: list[dict],
             for k, v in (s.get("backendState") or {}).items():
                 cur["backendState"][k] = max(
                     cur["backendState"].get(k, 0), v)
+            # Loop names are per node but may collide across nodes
+            # (every node has an "rpc" loop): lag takes the WORST
+            # node's EWMA (the cluster row answers "is any loop
+            # lagging"), tasks/pool counts sum like threads.
+            for k, v in (s.get("loopLag") or {}).items():
+                cur["loopLag"][k] = max(cur["loopLag"].get(k, 0), v)
+            for fld in ("loopTasks", "poolThreads", "poolBusy"):
+                for k, v in (s.get(fld) or {}).items():
+                    cur[fld][k] = cur[fld].get(k, 0) + v
             # Per-(kernel/bucket) WORST lane across nodes (highest
             # index = furthest from the device), same rule as backend
             # states: a cluster where any node fell back should say so.

@@ -379,6 +379,33 @@ class NoisyNeighborRule:
         return True, worst[1], round(worst[0], 4)
 
 
+class LoopStallRule:
+    """Event-loop stall (obs/loopmon.py flight recorder): a heartbeat
+    missed by more than ``obs.loop_stall_ms`` produced a stack capture
+    naming the frame that held the loop.  Breaches while any capture
+    is younger than the recorder's recent window — a ONE-SHOT block
+    (e.g. a 400ms faultinject ``loop_block``) still crosses the
+    pending_ticks hysteresis on 1s sampler ticks, then resolves once
+    the window drains.  The cause NAMES loop and blamed frame, and
+    firing freezes the capture ring into the incident bundle
+    (obs/incidents.py ``loops`` section)."""
+
+    name = "loop_stall"
+    kind = "event"
+
+    def evaluate(self, ctx: _EvalCtx):
+        from .loopmon import LOOPMON
+        events = LOOPMON.recent_stalls(now=ctx.now)
+        if not events:
+            return False, "", 0.0
+        worst = max(events, key=lambda e: e.get("overdueMs", 0.0))
+        cause = (f"loop {worst['loop']} stalled "
+                 f"{worst['overdueMs']:.0f}ms in {worst['topFrame']}"
+                 + (f" (+{len(events) - 1} more stall(s) in the "
+                    "window)" if len(events) > 1 else ""))
+        return True, cause, round(float(worst["overdueMs"]), 1)
+
+
 class ThresholdRule:
     """User-defined threshold over any registered metrics-v2 series
     (config-KV ``alerts rules``): sum of every series of ``metric``
@@ -452,7 +479,7 @@ def validate_user_rules(raw: str) -> list[dict]:
     builtin = {name for name, _, _ in BURN_SIGNALS} | {
         DriveRule.name, BackendRule.name, MrfRule.name,
         RecoveryRule.name, CacheRule.name, ResetRule.name,
-        NoisyNeighborRule.name}
+        NoisyNeighborRule.name, LoopStallRule.name}
     seen: set[str] = set()
     out: list[dict] = []
     for i, r in enumerate(doc):
@@ -668,7 +695,7 @@ class Watchdog:
             rules[name] = BurnRule(name, key, what)
         for r in (DriveRule(), BackendRule(), MrfRule(),
                   RecoveryRule(), CacheRule(), ResetRule(),
-                  NoisyNeighborRule()):
+                  NoisyNeighborRule(), LoopStallRule()):
             rules[r.name] = r
         for doc in user_docs:
             r = ThresholdRule(doc)
@@ -835,17 +862,25 @@ class Watchdog:
             span.add_event("alert", rule=tr["rule"],
                            alert_id=tr["alertId"], old=tr["old"],
                            new=tr["new"], cause=tr["cause"][:256])
-        wh = self._webhook
-        if wh is not None and tr["new"] in (FIRING, "resolved"):
-            wh.send(dict(tr, node="local"))
+        # Capture BEFORE the webhook post so the payload can carry the
+        # bundle id: an external pager needs the join key to link a
+        # firing alert to its frozen diagnosis (admin /incidents).
         if tr["new"] == FIRING:
             from .incidents import INCIDENTS
             try:
-                INCIDENTS.capture(tr)
+                bundle = INCIDENTS.capture(tr)
+                tr["bundleId"] = bundle.get("id", "")
             except Exception:  # noqa: BLE001 - diagnosis must not break alerting
                 Logger.get().log_once(
                     f"watchdog: incident capture failed for "
                     f"{tr['rule']}", "watchdog")
+        elif tr["new"] == "resolved" and tr.get("alertId"):
+            # The bundle frozen at firing is keyed by the alert id —
+            # the resolve notification joins to the same bundle.
+            tr["bundleId"] = tr["alertId"]
+        wh = self._webhook
+        if wh is not None and tr["new"] in (FIRING, "resolved"):
+            wh.send(dict(tr, node="local"))
 
     # -- reads ---------------------------------------------------------
 

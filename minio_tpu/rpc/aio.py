@@ -84,6 +84,16 @@ class _LoopThread:
                                      name=self._name, daemon=True)
                 t.start()
                 self._loop, self._thread = loop, t
+                # Health plane: the shared RPC loop carries EVERY peer
+                # call — a blocked callback here stalls the whole
+                # fabric, so it heartbeats under loopmon like the
+                # front-door loops (best-effort: obs must never gate
+                # the fabric).
+                try:
+                    from ..obs.loopmon import LOOPMON
+                    LOOPMON.register("rpc", loop)
+                except Exception:  # noqa: BLE001 - obs is optional here
+                    pass
             return self._loop
 
     def submit(self, coro):

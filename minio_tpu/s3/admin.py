@@ -526,6 +526,21 @@ class AdminHandlers:
             out["peers"] = notif.profiling_stop_all()
         return out
 
+    def h_profile(self, p, body):
+        """Continuous profiler (obs/loopmon.py): the always-on ~1%
+        duty-cycle sampler's per-minute aggregate — top-N self-time
+        rows plus pprof-style folded stacks ("f1;f2;f3 N", feed
+        straight to flamegraph.pl), and the loopmon per-loop health
+        census so a loop-stall investigation starts from ONE page.
+        ``?n=`` rows (default 50), ``?minutes=`` window (default 5)."""
+        from ..obs.loopmon import LOOPMON, ContinuousProfiler
+        n = min(500, max(1, int(p.get("n", "50") or 50)))
+        minutes = min(ContinuousProfiler.MINUTES_KEPT,
+                      max(1, int(p.get("minutes", "5") or 5)))
+        out = LOOPMON.profiler.report(top=n, minutes=minutes)
+        out["loops"] = LOOPMON.snapshot()
+        return out
+
     # -- bandwidth (ref pkg/bandwidth, admin /bandwidth route,
     # cmd/admin-router.go:217) -----------------------------------------
 

@@ -1233,6 +1233,11 @@ class AsyncFrontDoor:
             self._call_on(0, self._start_accept)
         for i in range(self._n_loops):
             self._call_on(i, self._start_sweep, self._loops[i])
+        # Health plane: every front-door loop heartbeats under loopmon
+        # (obs/loopmon.py) — scheduling lag, census, stall captures.
+        from ..obs.loopmon import LOOPMON
+        for i in range(self._n_loops):
+            LOOPMON.register(f"s3-{i}", self._loops[i])
         return bound
 
     def _run_loop(self, loop, ready) -> None:
@@ -1338,6 +1343,10 @@ class AsyncFrontDoor:
                 mine = [c for c in self._conns if c._loop is loop]
             for conn in mine:
                 conn.reap_if_idle(now, self.keepalive_timeout)
+            # Pool gauges go stale without connection churn (they only
+            # publish on open/close); the sweep keeps them honest on
+            # an idle server (rate-limiter dedupes across loops).
+            self._publish_gauges()
 
     # -- request execution (worker pool) -------------------------------
 
@@ -1409,6 +1418,22 @@ class AsyncFrontDoor:
         m = _metrics()
         m.set_gauge("minio_tpu_v2_open_connections", None, n)
         m.set_gauge("minio_tpu_v2_accept_queue_depth", None, pend)
+        # Per-pool thread census: splits the timeline's flat thread
+        # count so a stalled loop and an exhausted pool read
+        # differently in mtpu_top.  Busy = spawned threads minus the
+        # executor's idle semaphore (CPython internals, guarded — a
+        # missing attribute reads as an all-idle pool, never a crash).
+        for pname, pool in (("worker", self.pool),
+                            ("rpc", self.rpc_pool),
+                            ("stream", self.stream_pool)):
+            threads = len(getattr(pool, "_threads", ()) or ())
+            sem = getattr(pool, "_idle_semaphore", None)
+            idle = getattr(sem, "_value", threads)
+            m.set_gauge("minio_tpu_v2_pool_threads",
+                        {"pool": pname}, threads)
+            m.set_gauge("minio_tpu_v2_pool_threads_busy",
+                        {"pool": pname},
+                        max(0, threads - min(idle, threads)))
 
     def _flush_gauges(self) -> None:
         with self._mu:
@@ -1427,6 +1452,11 @@ class AsyncFrontDoor:
         finish within ``drain_s``, then abort stragglers and stop the
         loops."""
         self._running = False
+        # Heartbeats first: a loopmon task still pending when a loop
+        # closes would log "Task was destroyed but it is pending!".
+        from ..obs.loopmon import LOOPMON
+        for i in range(len(self._loops)):
+            LOOPMON.unregister(f"s3-{i}")
         for s in [*self._lsocks, self._lsock]:
             if s is None:
                 continue

@@ -2287,6 +2287,21 @@ class S3Server:
                         raise ValueError(
                             f"obs profile_on_slow={v!r}: must be "
                             "on/off")
+                elif key == "loop_stall_ms":
+                    try:
+                        # NaN-proof: `not (x > 0)` rejects NaN where
+                        # `x <= 0` would wave it through.
+                        if not (float(v) > 0):
+                            raise ValueError
+                    except ValueError:
+                        raise ValueError(
+                            f"obs loop_stall_ms={v!r}: must be a "
+                            "positive millisecond number")
+                elif key == "profile_continuous":
+                    if v not in ("on", "off"):
+                        raise ValueError(
+                            f"obs profile_continuous={v!r}: must be "
+                            "on/off")
                 elif key in ("timeline_sample", "timeline_retention"):
                     try:
                         if parse_duration(v) <= 0:
@@ -2655,6 +2670,24 @@ class S3Server:
             from ..logger import Logger
             Logger.get().log_once(
                 f"obs timeline config invalid, keeping previous: {e}",
+                "config")
+        # Event-loop health plane (obs/loopmon.py): the stall
+        # threshold and the continuous profiler reload live — an
+        # operator chasing a stall must be able to tighten the trip
+        # wire (or switch the profiler on) without a restart.
+        from ..obs.loopmon import LOOPMON
+        try:
+            _stall = float(cfg.get("obs", "loop_stall_ms"))
+            if not (_stall > 0):  # env bypasses _validate; NaN-proof
+                raise ValueError("loop_stall_ms must be positive")
+            LOOPMON.configure(
+                stall_ms=_stall,
+                profile_continuous=cfg.get(
+                    "obs", "profile_continuous") == "on")
+        except ValueError as e:  # env override may carry garbage
+            from ..logger import Logger
+            Logger.get().log_once(
+                f"obs loopmon config invalid, keeping previous: {e}",
                 "config")
         # Watchdog alert engine: windows/threshold/hysteresis/user
         # rules/webhook all reload live (an operator tuning an alert

@@ -5,7 +5,9 @@ miss every request handler thread).
 
 A sampler thread walks sys._current_frames() on an interval and
 aggregates inclusive sample counts per frame; the report is a flat
-"top functions" table like `pprof -top`.
+"top functions" table like `pprof -top`.  The frame walk itself is
+``sample_stacks`` so the continuous profiler (obs/loopmon.py) shares
+one stack-capture implementation with the on-demand burst profiler.
 """
 
 from __future__ import annotations
@@ -14,6 +16,37 @@ import sys
 import threading
 import time
 from collections import Counter
+
+# A frame key: (filename, firstlineno, name) — stable across calls and
+# cheap to aggregate on (the line is the DEF line, not the executing
+# line, so all samples inside one function collapse to one row).
+FrameKey = tuple[str, int, str]
+
+
+def sample_stacks(skip: set[int] | frozenset[int] = frozenset(),
+                  ) -> list[list[FrameKey]]:
+    """One sys._current_frames() walk: every thread's Python stack,
+    LEAF FIRST (stack[0] is the executing frame), excluding thread
+    idents in ``skip`` (the sampler itself must not profile its own
+    walk loop)."""
+    stacks: list[list[FrameKey]] = []
+    for tid, frame in sys._current_frames().items():
+        if tid in skip:
+            continue
+        stack: list[FrameKey] = []
+        while frame is not None:
+            code = frame.f_code
+            stack.append((code.co_filename, code.co_firstlineno,
+                          code.co_name))
+            frame = frame.f_back
+        stacks.append(stack)
+    return stacks
+
+
+def frame_label(key: FrameKey) -> str:
+    """Human row for a frame key: ``name (file.py:line)``."""
+    file, line, name = key
+    return f"{name} ({file.rsplit('/', 1)[-1]}:{line})"
 
 
 class SamplingProfiler:
@@ -37,25 +70,14 @@ class SamplingProfiler:
         self._thread.start()
 
     def _run(self) -> None:
-        me = threading.get_ident()
+        me = frozenset((threading.get_ident(),))
         while not self._stop.wait(self.interval):
             self.samples += 1
-            for tid, frame in sys._current_frames().items():
-                if tid == me:
-                    continue
-                seen = set()
-                leaf = True
-                while frame is not None:
-                    code = frame.f_code
-                    key = (code.co_filename, code.co_firstlineno,
-                           code.co_name)
-                    if leaf:
-                        self.leaf_counts[key] += 1
-                        leaf = False
-                    if key not in seen:
-                        seen.add(key)
-                        self.stack_counts[key] += 1
-                    frame = frame.f_back
+            for stack in sample_stacks(skip=me):
+                if stack:
+                    self.leaf_counts[stack[0]] += 1
+                for key in set(stack):
+                    self.stack_counts[key] += 1
 
     def stop(self) -> dict:
         self._stop.set()
@@ -72,11 +94,10 @@ class SamplingProfiler:
         def rows(counter: Counter) -> list[dict]:
             total = max(1, self.samples)
             return [{
-                "function": f"{name} ({file.rsplit('/', 1)[-1]}:"
-                            f"{line})",
+                "function": frame_label(key),
                 "samples": n,
                 "pct": round(100.0 * n / total, 1),
-            } for (file, line, name), n in counter.most_common(top)]
+            } for key, n in counter.most_common(top)]
 
         return {
             "durationSeconds": round(time.time() - self.started_at, 2),

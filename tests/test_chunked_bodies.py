@@ -323,3 +323,33 @@ def test_buffered_chunked_body_over_cap_is_413(door, monkeypatch):
     finally:
         s.close()
     _wait_inflight_zero(srv)
+
+
+# ---------------- torn abort under a stalled loop ----------------
+
+
+def test_blocked_loop_torn_chunked_put_releases_slot(door):
+    """The loopmon stall scenario mid-body: the client walks away from
+    a half-sent chunked PUT while every front-door loop is deliberately
+    blocked 400ms. The abort must still release the admission slot and
+    store nothing — a stalled loop delays teardown, it must never
+    swallow it. (On the threaded door the block lands on the loopmon
+    census only; the abort path is the same assertion.)"""
+    from minio_tpu.obs import loopmon
+    srv, port, cl = door
+    payload = os.urandom(300_000)
+    head, aws = _streaming_chunked_request("/bkt/stall", payload, port)
+    s = socket.create_connection(("127.0.0.1", port), timeout=30)
+    # Declare one huge TE chunk, send 30 KB of it...
+    s.sendall(head + f"{len(aws):x}\r\n".encode() + aws[:30_000])
+    time.sleep(0.2)
+    # ...block every loop while the body is half-read...
+    front = getattr(srv, "_front_door", None)
+    if front is not None:
+        for loop in front._loops:
+            loop.call_soon_threadsafe(loopmon._injected_loop_block,
+                                      0.4)
+    # ...and walk away mid-stall.
+    s.close()
+    _wait_inflight_zero(srv)
+    assert cl.get_object("bkt", "stall").status == 404

@@ -589,3 +589,51 @@ def test_async_loadgen_closed_loop(tmp_path):
         assert srv._front_door.open_connections() == 0
     finally:
         srv.stop()
+
+
+# ---------------- loop-under-stall (loopmon satellite) ----------------
+
+
+@needs_async_front
+def test_blocked_loop_put_completes_and_releases_slots(tmp_path):
+    """The loopmon stall scenario against real traffic: every
+    front-door loop gets a deliberate 400ms block while a PUT is in
+    flight. The request must complete once the block clears (delayed,
+    never dropped), admission slots must return to zero, and the
+    flight recorder must have captured the stall blaming the injected
+    frame — the lag -> blame chain on a live server."""
+    from minio_tpu.obs import loopmon
+    from minio_tpu.obs.loopmon import LOOPMON
+    srv, port = _start_server(tmp_path)
+    try:
+        LOOPMON.configure(stall_ms=150)
+        cl = S3Client("127.0.0.1", port, ACCESS, SECRET)
+        assert cl.make_bucket("stall").status == 200
+        front = srv._front_door
+        # Let every loop beat first (boot-time CPU storms can delay
+        # the first heartbeat) so the stall window is unambiguous.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and len(
+                [n for n in LOOPMON.lag_census() if
+                 n.startswith("s3-")]) < len(front._loops):
+            time.sleep(0.05)
+        for loop in front._loops:
+            loop.call_soon_threadsafe(loopmon._injected_loop_block,
+                                      0.4)
+        r = cl.put_object("stall", "k", b"x" * 50_000)
+        assert r.status == 200
+        got = cl.get_object("stall", "k")
+        assert got.status == 200 and got.body == b"x" * 50_000
+        _wait_inflight_zero(srv)
+        deadline = time.monotonic() + 10
+        blamed = []
+        while time.monotonic() < deadline and not blamed:
+            blamed = [e for e in LOOPMON.recent_stalls()
+                      if e["loop"].startswith("s3-")
+                      and e["topFrame"].startswith(
+                          "_injected_loop_block")]
+            time.sleep(0.05)
+        assert blamed, LOOPMON.snapshot()["stalls"]
+    finally:
+        srv.stop()
+        LOOPMON.configure(stall_ms=250)

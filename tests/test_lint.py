@@ -910,6 +910,44 @@ def test_o10_usage_literal_recording_calls():
         _ctx(bad, "minio_tpu/obs/timeline.py"))
 
 
+def test_o11_loopmon_profiler_literal_recording_calls():
+    from tools.mtpu_lint.rules.obs import LoopmonProfilerMetricCallRule
+    # POSITIVE: dynamic name + unregistered loop_* literal, in both
+    # scoped files of the loopmon/profiler family.
+    bad = ("def f(name):\n"
+           "    METRICS2.inc(name)\n"
+           "    METRICS2.observe('minio_tpu_v2_loop_bogus_ms',"
+           " {'loop': 's3-0'}, 1.0)\n")
+    for path in ("minio_tpu/obs/loopmon.py",
+                 "minio_tpu/utils/profiler.py"):
+        assert len(_check(LoopmonProfilerMetricCallRule(), bad,
+                          path)) == 2
+    # NEGATIVE: the real loop_*/pool_*/profile_* series are registered.
+    good = ("def f(loop, pool):\n"
+            "    METRICS2.observe('minio_tpu_v2_loop_lag_ms',"
+            " {'loop': loop}, 1.5)\n"
+            "    METRICS2.set_gauge('minio_tpu_v2_loop_lag_ewma_ms',"
+            " {'loop': loop}, 1.5)\n"
+            "    METRICS2.set_gauge('minio_tpu_v2_loop_tasks',"
+            " {'loop': loop}, 3)\n"
+            "    METRICS2.inc('minio_tpu_v2_loop_stalls_total',"
+            " {'loop': loop})\n"
+            "    METRICS2.set_gauge('minio_tpu_v2_pool_threads',"
+            " {'pool': pool}, 8)\n"
+            "    METRICS2.set_gauge('minio_tpu_v2_pool_threads_busy',"
+            " {'pool': pool}, 2)\n"
+            "    METRICS2.inc('minio_tpu_v2_profile_samples_total',"
+            " {}, 40)\n")
+    assert _check(LoopmonProfilerMetricCallRule(), good,
+                  "minio_tpu/obs/loopmon.py") == []
+    # Out of scope: the rule does not apply elsewhere in obs/ or
+    # utils/.
+    assert not LoopmonProfilerMetricCallRule().applies(
+        _ctx(bad, "minio_tpu/obs/timeline.py"))
+    assert not LoopmonProfilerMetricCallRule().applies(
+        _ctx(bad, "minio_tpu/utils/pipeline.py"))
+
+
 # ---------------------------------------------------------------------------
 # Framework: suppressions, baseline, output modes
 

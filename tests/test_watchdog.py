@@ -32,15 +32,27 @@ ACCESS, SECRET = "wdadmin", "wdadmin-secret"
 @pytest.fixture(autouse=True)
 def _clean_state():
     from minio_tpu.obs.kernprof import KERNPROF
+    from minio_tpu.obs.loopmon import LOOPMON
     WATCHDOG.reset()
     INCIDENTS.reset()
     KERNPROF.reset()
     FAULTS.clear()
+    # These tests assert EXACT transition lists; a genuine machine-load
+    # stall on a long-lived loop (the process-wide rpc loop stays
+    # registered across the suite) would make the built-in loop_stall
+    # rule ride along. Park the threshold and drop any stale captures.
+    prev_stall_ms = LOOPMON.stall_ms
+    LOOPMON.configure(stall_ms=60_000)
+    with LOOPMON._mu:
+        LOOPMON._stall_ring.clear()
     yield
     WATCHDOG.reset()
     INCIDENTS.reset()
     KERNPROF.reset()
     FAULTS.clear()
+    LOOPMON.configure(stall_ms=prev_stall_ms)
+    with LOOPMON._mu:
+        LOOPMON._stall_ring.clear()
 
 
 def S(t, cls="write", qps=0, errors=0, shed=0, slow=0, mrf=0,

@@ -10,7 +10,8 @@ from .errormap import ErrorMapRule
 from .kernels import KernelPurityRule
 from .locks import BlockingUnderLockRule
 from .obs import (AutotuneMetricCallRule, DrivemonSlowlogMetricCallRule,
-                  KernprofTimelineMetricCallRule, MetricNameRule,
+                  KernprofTimelineMetricCallRule,
+                  LoopmonProfilerMetricCallRule, MetricNameRule,
                   NativeAssertRule, PipelineMetricCallRule,
                   QosMetricCallRule, SelectMetricCallRule,
                   UsageMetricCallRule,
@@ -41,4 +42,5 @@ def all_rules():
         WatchdogIncidentMetricCallRule(),
         SelectMetricCallRule(),
         UsageMetricCallRule(),
+        LoopmonProfilerMetricCallRule(),
     ]

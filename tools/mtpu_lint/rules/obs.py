@@ -16,6 +16,8 @@ O9  s3select/ + ops/select_kernels.py recording calls likewise
     (select_* series)
 O10 obs/usage.py recording calls likewise (usage_* series + the
     cardinality-guard overflow counter)
+O11 obs/loopmon.py + utils/profiler.py recording calls likewise
+    (loop_*/pool_*/profile_* series)
 """
 
 from __future__ import annotations
@@ -173,3 +175,12 @@ class UsageMetricCallRule(_LiteralCallRule):
              "names")
     what = "usage"
     paths = ("minio_tpu/obs/usage.py",)
+
+
+class LoopmonProfilerMetricCallRule(_LiteralCallRule):
+    id = "O11"
+    title = ("loopmon/profiler metric recordings use literal "
+             "registered names")
+    what = "loopmon/profiler"
+    paths = ("minio_tpu/obs/loopmon.py",
+             "minio_tpu/utils/profiler.py")

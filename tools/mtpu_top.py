@@ -15,9 +15,14 @@ watchdog alert is FIRING, ``--once`` exits 2 (the alerts row shows
 firing/pending counts + the worst rule), so CI and the fault harness
 can use it as a one-shot health probe.
 
+``--json`` is the scripting twin of ``--once``: one machine-readable
+snapshot (the newest sample verbatim — loops, pools, alerts and all)
+on stdout, same exit-2-on-firing contract.
+
 Usage:
     python -m tools.mtpu_top --url http://127.0.0.1:9000 [--cluster]
     python -m tools.mtpu_top --url http://127.0.0.1:9000 --once
+    python -m tools.mtpu_top --url http://127.0.0.1:9000 --json
 """
 
 from __future__ import annotations
@@ -149,16 +154,39 @@ def render(doc: dict, width: int = 60) -> str:
     lines.append(f"rx {rx:.2f} MiB/s   tx {tx:.2f} MiB/s   "
                  f"admission queue {_num(last.get('queueDepth', 0))}")
     # Connection plane (async front door): open keep-alive sockets,
-    # accept backlog, framing rejections this window.
+    # accept backlog, framing rejections this window — plus the
+    # request-serving pools (busy/size), so an exhausted worker pool
+    # reads differently from a stalled loop.
+    pt = last.get("poolThreads") or {}
+    pb = last.get("poolBusy") or {}
+
+    def pool_cell(p: str) -> str:
+        return f"{p} {_num(pb.get(p, 0))}/{_num(pt.get(p, 0))}"
+
     lines.append(
         f"conns: open {_num(last.get('conns', 0))}  "
         f"accept-queue {_num(last.get('acceptQueue', 0))}  "
-        f"parse-err/s {_num(last.get('parseErrors', 0) / dt(last))}")
+        f"parse-err/s {_num(last.get('parseErrors', 0) / dt(last))}"
+        + (f"  pools[{pool_cell('worker')}  {pool_cell('stream')}]"
+           if "worker" in pt or "stream" in pt else ""))
     # Internal RPC fabric: peer calls in flight vs process threads —
-    # inflight >> threads means the async fabric is doing its job.
+    # inflight >> threads means the async fabric is doing its job;
+    # the rpc POOL is the sync-bridge remnant (busy/size).
     lines.append(
         f"rpc: inflight {_num(last.get('rpcInflight', 0))}  "
-        f"threads {_num(last.get('threads', 0))}")
+        f"threads {_num(last.get('threads', 0))}"
+        + (f"  pool[{pool_cell('rpc')}]" if "rpc" in pt else ""))
+    # Event-loop health (obs/loopmon.py census in each sample): EWMA
+    # scheduling lag + pending tasks per monitored loop — the runtime
+    # answer to "which loop is stalling".
+    ll = last.get("loopLag") or {}
+    lt = last.get("loopTasks") or {}
+    if ll:
+        cells = "  ".join(
+            f"{name}:{_num(ll.get(name, 0))}ms/"
+            f"{_num(lt.get(name, 0))}t"
+            for name in sorted(ll))
+        lines.append(f"loops: {cells}  (lag ewma / pending tasks)")
     # Hot-object cache row: hit ratio over the last window + resident
     # bytes (the serving tier's live effectiveness at a glance).
     ch = last.get("cacheHits", 0)
@@ -231,6 +259,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="read the cluster-merged timeline")
     ap.add_argument("--once", action="store_true",
                     help="print one snapshot and exit (no TTY needed)")
+    ap.add_argument("--json", action="store_true",
+                    help="one-shot machine-readable snapshot (every "
+                         "row's source fields verbatim); exits 2 on a "
+                         "firing alert like --once")
     ap.add_argument("--interval", type=float, default=1.0,
                     help="refresh seconds in live mode")
     ap.add_argument("--n", type=int, default=120,
@@ -246,7 +278,7 @@ def main(argv: list[str] | None = None) -> int:
                              timeout=args.timeout)
         return render(doc, width=args.width)
 
-    if args.once:
+    if args.once or args.json:
         try:
             doc = fetch_timeline(args.url, cluster=args.cluster,
                                  n=args.n, timeout=args.timeout)
@@ -254,9 +286,25 @@ def main(argv: list[str] | None = None) -> int:
             print(f"mtpu_top: cannot read timeline at {args.url}: "
                   f"{exc}", file=sys.stderr)
             return 1
-        print(render(doc, width=args.width))
+        if args.json:
+            # Machine-readable one-shot for scripting and the bench:
+            # the newest sample verbatim (every rendered row's source
+            # fields, loops/pools included), plus the firing census
+            # that drives the exit code.
+            samples = doc.get("samples", [])
+            print(json.dumps({
+                "fetchedAt": time.time(),
+                "periodS": doc.get("periodS", 1.0),
+                "nodes": doc.get("nodes", 1),
+                "samples": len(samples),
+                "firing": firing_count(doc),
+                "last": samples[-1] if samples else {},
+            }, sort_keys=True))
+        else:
+            print(render(doc, width=args.width))
         # Exit 2 when any alert is firing: `mtpu_top --once` becomes
-        # an assertable health probe for CI and the fault harness.
+        # an assertable health probe for CI and the fault harness
+        # (--json keeps the same contract).
         return 2 if firing_count(doc) else 0
 
     try:
