@@ -68,7 +68,7 @@ def _injected_loop_block(seconds: float) -> None:
     """Deliberate loop blocker (faultinject ``loop_block``): scheduled
     via ``call_soon`` so it runs ON the monitored loop — the stall
     recorder must catch exactly this frame."""
-    time.sleep(seconds)
+    time.sleep(seconds)  # mtpu-lint: disable=R11 -- faultinject loop_block: blocking ON the loop is this function's entire purpose (the stall recorder must blame this frame)
 
 
 class _LoopState:

@@ -206,6 +206,7 @@ class BackendRule:
         for b in down:
             err = str(info.get(b, {}).get("lastError") or "down")
             bits.append(f"{b} ({err.split('(', 1)[0].strip() or 'down'})")
+        # mtpu-lint: disable=R13 -- hand-sanitized above: only the exception CLASS (split before the first paren) rides into the cause, never the repr body; the taint engine cannot see through the split
         return (True, "kernel backend down: " + ", ".join(bits),
                 float(len(down)))
 
@@ -324,16 +325,19 @@ class NoisyNeighborRule:
     while the class is actually SHEDDING and at least one other
     entity shares it (skew without contention, or a class with a
     single tenant, is a workload shape, not an incident).
-    The cause NAMES the tenant, which is what turns the alert into an
-    input the per-class QoS caps (or a future per-tenant throttle)
-    can act on; firing freezes the usage snapshot into the incident
-    bundle (obs/incidents.py carries a ``usage`` section)."""
+    The cause names the tenant by its REDACTED identity (stable
+    ``_redact_name`` digest — same policy as DriveRule's drive ids,
+    because causes are served on the unauthenticated /v2/alerts
+    surface); firing freezes the usage snapshot with the verbatim
+    names into the incident bundle (obs/incidents.py carries a
+    ``usage`` section), which is where the per-class QoS caps or a
+    future per-tenant throttle look up who it actually was."""
 
     name = "noisy_neighbor"
     kind = "event"
 
     def evaluate(self, ctx: _EvalCtx):
-        from .usage import USAGE
+        from .usage import USAGE, _redact_name
         if not USAGE.enabled:
             return False, "", 0.0
         fast = USAGE.class_shares(USAGE.fast_s, ctx.now)
@@ -367,7 +371,11 @@ class NoisyNeighborRule:
                         or s.get("share", 0.0) < share_min):
                     continue
                 kind = "tenant" if "Tenant" in key else "bucket"
-                cause = (f"{kind} {f['name']!r} carries "
+                # REDACTED identity, same policy as DriveRule's drive
+                # ids: causes are served on the unauthenticated
+                # /v2/alerts surface; the incident bundle (admin)
+                # freezes the usage snapshot with the verbatim name.
+                cause = (f"{kind} {_redact_name(f['name'])!r} carries "
                          f"{f['share']:.2f} of {cls} {what} "
                          f"(fast {USAGE.fast_s:g}s) / "
                          f"{s['share']:.2f} (slow {USAGE.slow_s:g}s)"

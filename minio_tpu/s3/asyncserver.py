@@ -1301,18 +1301,23 @@ class AsyncFrontDoor:
             self._publish_gauges()
             if pinned:
                 # The kernel already picked this loop: establish
-                # in-place, zero handoff.
-                loop.create_task(self._establish(sock, loop))
+                # in-place, zero handoff.  track_task keeps a strong
+                # reference — the loop holds tasks only weakly, and an
+                # untracked _establish could be garbage-collected
+                # mid-handshake with its exception never observed.
+                self.track_task(loop.create_task(
+                    self._establish(sock, loop)))
                 continue
             target = self._loops[self._next_loop % self._n_loops]
             self._next_loop += 1
             if target is loop:
                 # Same loop (the 1-loop default): a direct task skips
                 # the threadsafe self-pipe round trip per accept.
-                loop.create_task(self._establish(sock, target))
+                self.track_task(loop.create_task(
+                    self._establish(sock, target)))
             else:
-                asyncio.run_coroutine_threadsafe(
-                    self._establish(sock, target), target)
+                self.track_task(asyncio.run_coroutine_threadsafe(
+                    self._establish(sock, target), target))
 
     async def _establish(self, sock, loop) -> None:
         """Runs on the connection's OWN loop: TLS handshake (when

@@ -16,8 +16,8 @@ import pytest
 
 from minio_tpu.obs.incidents import INCIDENTS
 from minio_tpu.obs.metrics2 import METRICS2, MetricsV2, _OVERFLOW
-from minio_tpu.obs.usage import (OTHER, USAGE, TopKSketch, merge_topk,
-                                 merge_usage, redact_usage)
+from minio_tpu.obs.usage import (OTHER, USAGE, TopKSketch, _redact_name,
+                                 merge_topk, merge_usage, redact_usage)
 from minio_tpu.obs.watchdog import WATCHDOG, Watchdog
 
 ACCESS, SECRET = "usageadmin", "usageadmin-secret"
@@ -314,8 +314,14 @@ def test_noisy_neighbor_fires_with_cause_gauge_and_bundle():
     trs = wd.tick(now=now, samples=[])
     fired = [t for t in trs if t["new"] == "firing"]
     assert fired
-    # Sink 1: the cause NAMES the tenant.
-    assert "hot" in fired[0]["cause"]
+    # Sink 1: the cause names the tenant by REDACTED identity only —
+    # causes ride the unauthenticated /v2/alerts surface (R13), so the
+    # verbatim name must never appear; the stable digest still lets an
+    # operator correlate across alerts, and the incident bundle below
+    # carries the real name for the authenticated surface.
+    assert _redact_name("ak-hot") in fired[0]["cause"]
+    assert "ak-hot" not in fired[0]["cause"]
+    assert "'hot'" not in fired[0]["cause"]
     assert "write" in fired[0]["cause"]
     # Sink 2: the firing gauge.
     assert METRICS2.get("minio_tpu_v2_alerts_firing",

@@ -1,0 +1,904 @@
+"""Whole-program symbol table, call graph, and taint dataflow.
+
+The per-module rules (R1–R10, O*) see one AST at a time, which is
+exactly the blindness that let a sync helper ``time.sleep`` two frames
+below an ``async def`` — and an unredacted drive path three modules
+away from its ``/v2`` handler — ship clean.  This module builds the
+cross-module view once per lint run and hands it to interprocedural
+rules (R11–R14) through :class:`Program`.
+
+Resolution strategy (good enough for THIS codebase's conventions, and
+honest about the rest):
+
+- module-level ``def``s / ``class``es, nested ``def``s (own nodes,
+  qname ``outer.<locals>.inner``);
+- imports, including the pervasive function-level relative imports
+  (``from ..logger import Logger`` inside a method body) and package
+  ``__init__`` re-exports (import binding runs to a fixpoint);
+- module-level singletons (``DRIVEMON = DriveMonitor()``) — local or
+  re-imported — resolve ``DRIVEMON.snapshot()`` to the method;
+- ``self.method()``, single-inheritance base-class methods, and
+  ``self.attr.method()`` via class attribute types inferred from
+  ``self.attr = ClassName(...)`` / class-level ``attr = ClassName()``;
+- local variable receivers typed by direct constructor assignment
+  (``mon = DriveMonitor(); mon.snapshot()``).
+
+Everything else becomes an UNRESOLVED edge carrying a reason string —
+never a silently dropped one — so each rule chooses its own closure:
+R11/R12 are permissive (only proven chains are findings), the taint
+layer propagates through unresolved calls (they forward their
+arguments' taint but introduce none).
+
+The taint layer is a flow-insensitive, per-function fixpoint over
+variable environments with memoized, parameter-sensitive summaries:
+``summary(f)`` says which tags ``f``'s return value always carries and
+which of its parameters' taint it forwards.  A function *reference*
+passed as an argument collapses to the referenced function's return
+tags, which is what lets taint cross the higher-order
+``_cached_cluster_scrape(cache_attr, build)`` seam in s3/server.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from .core import ModuleCtx, dotted_name, terminal_name
+
+_PARAM_TAG = re.compile(r"^@param:(\d+)$")
+
+
+def param_tag(i: int) -> str:
+    return f"@param:{i}"
+
+
+@dataclass
+class CallSite:
+    node: ast.Call
+    caller: "FuncInfo"
+    callee: str | None = None      # FuncInfo qname when resolved
+    unresolved: str | None = None  # reason when callee is None
+    awaited: bool = False
+
+
+class FuncInfo:
+    def __init__(self, qname: str, node, ctx: ModuleCtx,
+                 cls: "ClassInfo | None", parent: "FuncInfo | None"):
+        self.qname = qname
+        self.node = node
+        self.ctx = ctx
+        self.relpath = ctx.relpath
+        self.cls = cls
+        self.parent = parent           # enclosing function, for nested defs
+        self.is_async = isinstance(node, ast.AsyncFunctionDef)
+        self.nested: dict[str, FuncInfo] = {}
+        self.calls: list[CallSite] = []
+        self.params: list[str] = [a.arg for a in (
+            node.args.posonlyargs + node.args.args)]
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    def short(self) -> str:
+        """`server.py::S3Server.handle_ops` — readable in messages."""
+        return f"{self.relpath.rsplit('/', 1)[-1]}::" \
+               f"{self.qname.split('::', 1)[1]}"
+
+
+class ClassInfo:
+    def __init__(self, qname: str, node: ast.ClassDef, ctx: ModuleCtx):
+        self.qname = qname
+        self.name = node.name
+        self.node = node
+        self.ctx = ctx
+        self.methods: dict[str, FuncInfo] = {}
+        self.base_names: list[str] = [dotted_name(b) for b in node.bases]
+        self.bases: list[ClassInfo] = []          # resolved in pass 2
+        self.attr_exprs: list[tuple[str, ast.expr]] = []  # attr = <ctor?>
+        self.attr_types: dict[str, str] = {}      # attr -> class qname
+
+    def find_method(self, name: str,
+                    _seen: set[str] | None = None) -> FuncInfo | None:
+        seen = _seen or set()
+        if self.qname in seen:
+            return None
+        seen.add(self.qname)
+        m = self.methods.get(name)
+        if m is not None:
+            return m
+        for b in self.bases:
+            m = b.find_method(name, seen)
+            if m is not None:
+                return m
+        return None
+
+
+def _module_name(relpath: str) -> str:
+    """'minio_tpu/s3/server.py' -> 'minio_tpu.s3.server';
+    '__init__.py' maps to its package."""
+    mod = relpath[:-3] if relpath.endswith(".py") else relpath
+    if mod.endswith("/__init__"):
+        mod = mod[: -len("/__init__")]
+    return mod.replace("/", ".")
+
+
+# A namespace binding: what a bare name means at module scope.
+#   ("func", qname) | ("class", class_qname) | ("instance", class_qname)
+#   | ("module", module_dotted) | ("external", dotted)
+Binding = tuple[str, str]
+
+
+class _Module:
+    def __init__(self, ctx: ModuleCtx):
+        self.ctx = ctx
+        self.relpath = ctx.relpath
+        self.modname = _module_name(ctx.relpath)
+        self.package = self.modname.rsplit(".", 1)[0] \
+            if "." in self.modname else ""
+        if ctx.relpath.endswith("/__init__.py"):
+            self.package = self.modname
+        self.ns: dict[str, Binding] = {}
+        self.pending_imports: list[tuple[str, str, str]] = []
+        # [(bound_name, source_modname, source_attr)]
+        self.assigns: list[tuple[str, ast.expr]] = []  # NAME = <expr>
+
+
+class Program:
+    """The whole-program view handed to interprocedural rules."""
+
+    def __init__(self):
+        self.functions: dict[str, FuncInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        self.modules: dict[str, _Module] = {}       # by modname
+        self.by_relpath: dict[str, _Module] = {}
+
+    # -- construction --------------------------------------------------
+
+    @classmethod
+    def build(cls, ctxs: list[ModuleCtx]) -> "Program":
+        prog = cls()
+        for ctx in ctxs:
+            if not ctx.relpath.endswith(".py"):
+                continue
+            m = _Module(ctx)
+            prog.modules[m.modname] = m
+            prog.by_relpath[m.relpath] = m
+        for m in prog.modules.values():
+            prog._register_defs(m)
+        # Import and instance binding interleave to a fixpoint: a
+        # `from .usage import USAGE` can only bind once usage.py's
+        # `USAGE = UsageAccountant()` has been classified, and THAT
+        # may need an imported class — so neither pass can run first.
+        for _ in range(8):
+            progress = prog._bind_imports_pass()
+            progress |= prog._bind_instances_pass()
+            if not progress:
+                break
+        prog._finalize_bindings()
+        prog._resolve_class_attrs()
+        for f in prog.functions.values():
+            prog._collect_calls(f)
+        return prog
+
+    def _register_defs(self, m: _Module) -> None:
+        for stmt in m.ctx.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._register_func(m, stmt, cls=None, parent=None)
+            elif isinstance(stmt, ast.ClassDef):
+                self._register_class(m, stmt)
+            elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                m.assigns.append((stmt.targets[0].id, stmt.value))
+            elif isinstance(stmt, (ast.Import, ast.ImportFrom)):
+                self._note_import(m, stmt)
+        # Function-level imports are pervasive (cycle-breaking idiom);
+        # fold them into the module namespace — name collisions with
+        # different targets are not a thing this tree does.
+        for node in ast.walk(m.ctx.tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)) \
+                    and node not in m.ctx.tree.body:
+                self._note_import(m, node)
+
+    def _register_func(self, m: _Module, node, cls, parent) -> None:
+        if parent is not None:
+            qname = f"{parent.qname}.<locals>.{node.name}"
+        elif cls is not None:
+            qname = f"{m.relpath}::{cls.name}.{node.name}"
+        else:
+            qname = f"{m.relpath}::{node.name}"
+        f = FuncInfo(qname, node, m.ctx, cls, parent)
+        self.functions[qname] = f
+        if parent is not None:
+            parent.nested[node.name] = f
+        elif cls is not None:
+            cls.methods[node.name] = f
+        else:
+            m.ns[node.name] = ("func", qname)
+        for inner in node.body:
+            if isinstance(inner, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._register_func(m, inner, cls=None, parent=f)
+
+    def _register_class(self, m: _Module, node: ast.ClassDef) -> None:
+        qname = f"{m.relpath}::{node.name}"
+        ci = ClassInfo(qname, node, m.ctx)
+        self.classes[qname] = ci
+        m.ns[node.name] = ("class", qname)
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._register_func(m, stmt, cls=ci, parent=None)
+            elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                ci.attr_exprs.append((stmt.targets[0].id, stmt.value))
+        # self.attr = <expr> inside methods (constructor-first order so
+        # __init__ wins on duplicates — it runs first at runtime too).
+        for meth in sorted(ci.methods.values(),
+                           key=lambda f: f.name != "__init__"):
+            for sub in ast.walk(meth.node):
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                    t = sub.targets[0]
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                            and not any(a == t.attr
+                                        for a, _ in ci.attr_exprs)):
+                        ci.attr_exprs.append((t.attr, sub.value))
+
+    def _note_import(self, m: _Module, node) -> None:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                name = alias.asname or alias.name.split(".")[0]
+                target = alias.name if alias.asname else \
+                    alias.name.split(".")[0]
+                if target in self.modules or alias.name in self.modules:
+                    m.ns[name] = ("module",
+                                  alias.name if alias.asname else target)
+                else:
+                    m.ns.setdefault(name, ("external", alias.name))
+            return
+        # ImportFrom: resolve the source module (relative or absolute).
+        src = node.module or ""
+        if node.level:
+            base = m.package.split(".") if m.package else []
+            if node.level > 1:
+                base = base[: -(node.level - 1)] if node.level - 1 <= \
+                    len(base) else []
+            src = ".".join(base + ([src] if src else []))
+        for alias in node.names:
+            name = alias.asname or alias.name
+            child = f"{src}.{alias.name}" if src else alias.name
+            if child in self.modules:            # from pkg import mod
+                m.ns[name] = ("module", child)
+            elif src in self.modules:
+                m.pending_imports.append((name, src, alias.name))
+            else:
+                m.ns.setdefault(name, ("external", f"{src}.{alias.name}"
+                                       if src else alias.name))
+
+    def _bind_imports_pass(self) -> bool:
+        progress = False
+        for m in self.modules.values():
+            still: list[tuple[str, str, str]] = []
+            for name, src, attr in m.pending_imports:
+                b = self.modules[src].ns.get(attr)
+                if b is not None:
+                    m.ns[name] = b
+                    progress = True
+                else:
+                    still.append((name, src, attr))
+            m.pending_imports = still
+        return progress
+
+    def _bind_instances_pass(self) -> bool:
+        # NAME = ClassName(...) at module level; the class may itself
+        # arrive via a not-yet-bound import, hence the outer fixpoint.
+        progress = False
+        for m in self.modules.values():
+            for name, expr in m.assigns:
+                if name in m.ns:
+                    continue
+                cq = self._class_of_expr(m, expr)
+                if cq is not None:
+                    m.ns[name] = ("instance", cq)
+                    progress = True
+        return progress
+
+    def _finalize_bindings(self) -> None:
+        for m in self.modules.values():
+            for name, src, attr in m.pending_imports:
+                # Source module exists but never binds the name (an
+                # instance assigned later, a __getattr__, ...) — keep
+                # it visible as external rather than dropping it.
+                m.ns.setdefault(name, ("external", f"{src}.{attr}"))
+            m.pending_imports = []
+            for name, expr in m.assigns:
+                if name not in m.ns and isinstance(expr, ast.Call):
+                    m.ns[name] = ("external", "")
+
+    def _class_of_expr(self, m: _Module, expr: ast.expr) -> str | None:
+        """class qname when `expr` is a constructor call of a known
+        class (possibly imported), else None."""
+        if not isinstance(expr, ast.Call):
+            return None
+        b = self._lookup(m, expr.func)
+        if b is not None and b[0] == "class":
+            return b[1]
+        return None
+
+    def _lookup(self, m: _Module, expr: ast.expr) -> Binding | None:
+        """Resolve a Name/Attribute chain against module namespaces."""
+        if isinstance(expr, ast.Name):
+            return m.ns.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            base = self._lookup(m, expr.value)
+            if base is None:
+                return None
+            kind, target = base
+            if kind == "module":
+                sub = f"{target}.{expr.attr}"
+                if sub in self.modules:
+                    return ("module", sub)
+                src = self.modules.get(target)
+                if src is not None:
+                    return src.ns.get(expr.attr)
+                return ("external", sub)
+            if kind == "external":
+                return ("external", f"{target}.{expr.attr}")
+            return None
+        return None
+
+    def _resolve_class_attrs(self) -> None:
+        for ci in self.classes.values():
+            m = self.by_relpath[ci.ctx.relpath]
+            for bn in ci.base_names:
+                b = self._lookup(m, ast.parse(bn or "object",
+                                              mode="eval").body) \
+                    if bn else None
+                if b is not None and b[0] == "class":
+                    ci.bases.append(self.classes[b[1]])
+            for attr, expr in ci.attr_exprs:
+                cq = self._class_of_expr(m, expr)
+                if cq is not None:
+                    ci.attr_types[attr] = cq
+
+    # -- reference / call resolution -----------------------------------
+
+    def resolve_ref(self, f: FuncInfo, expr: ast.expr) -> FuncInfo | None:
+        """A *reference* to a program function (not a call): bare name,
+        self.method, SINGLETON.method, mod.func, Class.method, nested."""
+        m = self.by_relpath[f.relpath]
+        if isinstance(expr, ast.Name):
+            scope: FuncInfo | None = f
+            while scope is not None:
+                if expr.id in scope.nested:
+                    return scope.nested[expr.id]
+                scope = scope.parent
+            b = m.ns.get(expr.id)
+            if b is not None and b[0] == "func":
+                return self.functions.get(b[1])
+            return None
+        if isinstance(expr, ast.Attribute):
+            cls = self._receiver_class(f, expr.value)
+            if cls is not None:
+                return cls.find_method(expr.attr)
+            b = self._lookup(m, expr)
+            if b is not None and b[0] == "func":
+                return self.functions.get(b[1])
+            return None
+        return None
+
+    def _local_types(self, f: FuncInfo) -> dict[str, str]:
+        """name -> class qname for `n = ClassName(...)` assignments
+        directly in f's body (nested defs excluded)."""
+        cached = getattr(f, "_local_types", None)
+        if cached is not None:
+            return cached
+        m = self.by_relpath[f.relpath]
+        out: dict[str, str] = {}
+        stack = list(f.node.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                cq = self._class_of_expr(m, node.value)
+                if cq is not None:
+                    out[node.targets[0].id] = cq
+            stack.extend(ast.iter_child_nodes(node))
+        f._local_types = out
+        return out
+
+    def _receiver_class(self, f: FuncInfo,
+                        recv: ast.expr) -> ClassInfo | None:
+        """The class of a method-call receiver, when inferable."""
+        m = self.by_relpath[f.relpath]
+        if isinstance(recv, ast.Name):
+            if recv.id == "self" and f.cls is not None:
+                return f.cls
+            if recv.id in ("self", "cls"):
+                # self in a nested def: the enclosing method's class.
+                scope = f.parent
+                while scope is not None:
+                    if scope.cls is not None:
+                        return scope.cls
+                    scope = scope.parent
+            lt = self._local_types(f).get(recv.id)
+            if lt is not None:
+                return self.classes.get(lt)
+            b = m.ns.get(recv.id)
+            if b is not None and b[0] in ("instance", "class"):
+                return self.classes.get(b[1])
+            return None
+        if isinstance(recv, ast.Attribute):
+            if isinstance(recv.value, ast.Name) \
+                    and recv.value.id == "self":
+                owner = self._receiver_class(f, recv.value)
+                if owner is not None:
+                    cq = owner.attr_types.get(recv.attr)
+                    if cq is not None:
+                        return self.classes.get(cq)
+                return None
+            b = self._lookup(m, recv)
+            if b is not None and b[0] in ("instance", "class"):
+                return self.classes.get(b[1])
+            return None
+        if isinstance(recv, ast.Call):
+            cq = self._class_of_expr(m, recv)
+            if cq is not None:
+                return self.classes.get(cq)
+        return None
+
+    def _resolve_call(self, f: FuncInfo,
+                      call: ast.Call) -> tuple[str | None, str | None]:
+        """(callee qname, None) or (None, unresolved-reason)."""
+        m = self.by_relpath[f.relpath]
+        func = call.func
+        target = self.resolve_ref(f, func)
+        if target is not None:
+            return target.qname, None
+        if isinstance(func, ast.Name):
+            b = m.ns.get(func.id)
+            if b is not None and b[0] == "class":
+                init = self.classes[b[1]].find_method("__init__")
+                if init is not None:
+                    return init.qname, None
+                return None, f"ctor:{b[1]}"
+            if b is not None and b[0] == "external":
+                return None, f"external:{b[1] or func.id}"
+            if func.id in f.params:
+                return None, f"param:{func.id}"
+            return None, f"name:{func.id}"
+        if isinstance(func, ast.Attribute):
+            b = self._lookup(m, func)
+            if b is not None and b[0] == "class":
+                init = self.classes[b[1]].find_method("__init__")
+                if init is not None:
+                    return init.qname, None
+                return None, f"ctor:{b[1]}"
+            if b is not None and b[0] == "external":
+                return None, f"external:{b[1]}"
+            cls = self._receiver_class(f, func.value)
+            if cls is not None:
+                # Known class, unknown method (dynamic or inherited
+                # from an external base).
+                return None, f"method:{cls.name}.{func.attr}"
+            return None, f"attr:{dotted_name(func) or func.attr}"
+        return None, "dynamic"
+
+    def _collect_calls(self, f: FuncInfo) -> None:
+        awaited: set[int] = set()
+        stack: list[ast.AST] = list(f.node.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested defs carry their own call lists
+            if isinstance(node, ast.Await) \
+                    and isinstance(node.value, ast.Call):
+                awaited.add(id(node.value))
+            if isinstance(node, ast.Call):
+                callee, why = self._resolve_call(f, node)
+                f.calls.append(CallSite(
+                    node, f, callee, why, id(node) in awaited))
+            stack.extend(ast.iter_child_nodes(node))
+
+    def func_at(self, relpath: str, name: str) -> FuncInfo | None:
+        return self.functions.get(f"{relpath}::{name}")
+
+
+# -- taint dataflow ----------------------------------------------------------
+
+
+@dataclass
+class Summary:
+    """What a function's return value carries: `tags` always, plus the
+    call-site taint of every parameter index in `params`."""
+    tags: frozenset = frozenset()
+    params: frozenset = frozenset()
+
+
+class TaintSpec:
+    """What a taint-based rule declares; subclass or fill the fields.
+
+    ``source_calls`` maps resolved qnames OR external dotted names to
+    the tags their return value introduces.  ``sanitizers`` are calls
+    whose return value is clean regardless of arguments (matched by
+    qname or by terminal function name in ``sanitizer_names`` for
+    robustness against import aliasing).  ``exception_tags`` are given
+    to names bound by ``except ... as e``.
+
+    ``key_tags(base_tags, key)`` is the field-sensitivity hook: extra
+    tags for a literal-string-key lookup (``x["endpoint"]`` /
+    ``x.get("endpoint")``), given the taint of the base.  It lets a
+    rule use CARRIER tags — ``DriveMonitor.snapshot()`` returns a doc
+    tagged ``DRIVES_DOC`` and only the ``["endpoint"]`` field lookup
+    derives the violation tag — so a share ratio pulled out of the
+    same doc does not false-positive the cause string it lands in.
+    Unconditional key tags (config credential keys) ignore
+    ``base_tags``."""
+
+    source_calls: dict = {}
+    sanitizers: frozenset = frozenset()
+    sanitizer_names: frozenset = frozenset()
+    exception_tags: frozenset = frozenset()
+
+    def key_tags(self, base_tags: frozenset, key: str) -> frozenset:
+        return frozenset()
+
+
+_MUTATORS = {"append", "extend", "update", "add", "insert", "setdefault",
+             "appendleft"}
+
+
+class TaintEngine:
+    """Flow-insensitive forward taint with memoized per-function
+    summaries.  Policy for unresolved/external calls: PROPAGATE
+    THROUGH — the result carries the union of the receiver's and the
+    arguments' taint, but no new tags (an unknown callee must not
+    manufacture findings, and must not launder taint either)."""
+
+    MAX_PASSES = 8
+
+    def __init__(self, program: Program, spec: TaintSpec):
+        self.program = program
+        self.spec = spec
+        self._summaries: dict[str, Summary] = {}
+        self._in_progress: set[str] = set()
+        self._analyses: dict[str, tuple[dict, dict, list]] = {}
+
+    # -- public API ----------------------------------------------------
+
+    def summary(self, f: FuncInfo) -> Summary:
+        if f.qname in self._summaries:
+            return self._summaries[f.qname]
+        if f.qname in self._in_progress:
+            return Summary()  # recursion: optimistic bottom
+        self._in_progress.add(f.qname)
+        try:
+            _env, _nodes, returns = self._analyze(f)
+            tags: set = set()
+            params: set = set()
+            for _node, t in returns:
+                for tag in t:
+                    mp = _PARAM_TAG.match(tag)
+                    if mp:
+                        params.add(int(mp.group(1)))
+                    else:
+                        tags.add(tag)
+            s = Summary(frozenset(tags), frozenset(params))
+            self._summaries[f.qname] = s
+            return s
+        finally:
+            self._in_progress.discard(f.qname)
+
+    def taint_of(self, f: FuncInfo, node: ast.AST) -> frozenset:
+        """Concrete tags of an expression in f (param placeholders
+        dropped — callers of this API ask about real sources)."""
+        _env, nodes, _returns = self._analyze(f)
+        return frozenset(t for t in nodes.get(id(node), frozenset())
+                         if not _PARAM_TAG.match(t))
+
+    def return_taints(self, f: FuncInfo) -> list:
+        """[(return-value expr node, concrete tags)] for f."""
+        _env, _nodes, returns = self._analyze(f)
+        return [(n, frozenset(t for t in tags if not _PARAM_TAG.match(t)))
+                for n, tags in returns]
+
+    # -- per-function fixpoint -----------------------------------------
+
+    def _analyze(self, f: FuncInfo):
+        cached = self._analyses.get(f.qname)
+        if cached is not None:
+            return cached
+        env: dict[str, frozenset] = {
+            p: frozenset({param_tag(i)}) for i, p in enumerate(f.params)}
+        nodes: dict[int, frozenset] = {}
+        returns: list = []
+        for _ in range(self.MAX_PASSES):
+            before = dict(env)
+            returns = []
+            for stmt in f.node.body:
+                self._exec(stmt, env, nodes, returns, f)
+            if env == before:
+                break
+        result = (env, nodes, returns)
+        self._analyses[f.qname] = result
+        return result
+
+    def _exec(self, stmt, env, nodes, returns, f) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, ast.Return):
+            t = self._eval(stmt.value, env, nodes, f) \
+                if stmt.value is not None else frozenset()
+            returns.append((stmt.value, t))
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            if value is None:
+                return
+            t = self._eval(value, env, nodes, f)
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            for tgt in targets:
+                self._assign(tgt, t, env, nodes, f,
+                             aug=isinstance(stmt, ast.AugAssign))
+            return
+        if isinstance(stmt, ast.Expr):
+            self._eval(stmt.value, env, nodes, f)
+            # container.append(x) / d.update(x): the receiver absorbs
+            # the arguments' taint.
+            v = stmt.value
+            if isinstance(v, ast.Call) and isinstance(v.func,
+                                                      ast.Attribute) \
+                    and v.func.attr in _MUTATORS:
+                t = frozenset().union(*(
+                    [self._eval(a, env, nodes, f) for a in v.args]
+                    + [self._eval(kw.value, env, nodes, f)
+                       for kw in v.keywords] + [frozenset()]))
+                root = v.func.value
+                while isinstance(root, ast.Subscript):
+                    root = root.value
+                name = self._root_name(root)
+                if name is not None and t:
+                    env[name] = env.get(name, frozenset()) | t
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            t = self._eval(stmt.iter, env, nodes, f)
+            self._assign(stmt.target, t, env, nodes, f)
+            for s in stmt.body + stmt.orelse:
+                self._exec(s, env, nodes, returns, f)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                t = self._eval(item.context_expr, env, nodes, f)
+                if item.optional_vars is not None:
+                    self._assign(item.optional_vars, t, env, nodes, f)
+            for s in stmt.body:
+                self._exec(s, env, nodes, returns, f)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._eval(stmt.test, env, nodes, f)
+            for s in stmt.body + stmt.orelse:
+                self._exec(s, env, nodes, returns, f)
+            return
+        if isinstance(stmt, ast.Try):
+            for s in stmt.body:
+                self._exec(s, env, nodes, returns, f)
+            for h in stmt.handlers:
+                if h.name and self.spec.exception_tags:
+                    env[h.name] = env.get(h.name, frozenset()) \
+                        | self.spec.exception_tags
+                for s in h.body:
+                    self._exec(s, env, nodes, returns, f)
+            for s in stmt.orelse + stmt.finalbody:
+                self._exec(s, env, nodes, returns, f)
+            return
+        # Anything else: evaluate child expressions for node taints.
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._eval(child, env, nodes, f)
+            elif isinstance(child, ast.stmt):
+                self._exec(child, env, nodes, returns, f)
+
+    @staticmethod
+    def _root_name(expr) -> str | None:
+        if isinstance(expr, ast.Name):
+            return expr.id
+        if isinstance(expr, ast.Attribute):
+            d = dotted_name(expr)
+            return d or None
+        return None
+
+    def _assign(self, tgt, t: frozenset, env, nodes, f,
+                aug: bool = False) -> None:
+        if isinstance(tgt, ast.Name):
+            env[tgt.id] = (env.get(tgt.id, frozenset()) | t) if aug else t
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._assign(el, t, env, nodes, f)
+        elif isinstance(tgt, (ast.Subscript, ast.Attribute,
+                              ast.Starred)):
+            # d[k] = v / obj.attr = v / *rest = v: the base absorbs.
+            base = tgt.value if not isinstance(tgt, ast.Starred) \
+                else tgt.value
+            name = self._root_name(base) if not isinstance(
+                base, ast.Subscript) else self._root_name(base.value)
+            if isinstance(tgt, ast.Starred):
+                self._assign(tgt.value, t, env, nodes, f)
+                return
+            if name is not None and t:
+                env[name] = env.get(name, frozenset()) | t
+
+    # -- expression evaluation -----------------------------------------
+
+    def _eval(self, expr, env, nodes, f) -> frozenset:
+        t = self._eval_inner(expr, env, nodes, f)
+        if t:
+            nodes[id(expr)] = t
+        return t
+
+    def _eval_inner(self, expr, env, nodes, f) -> frozenset:
+        sp = self.spec
+        if expr is None or isinstance(expr, ast.Constant):
+            return frozenset()
+        if isinstance(expr, ast.Name):
+            return env.get(expr.id, frozenset())
+        if isinstance(expr, ast.Await):
+            return self._eval(expr.value, env, nodes, f)
+        if isinstance(expr, ast.Attribute):
+            base = self._eval(expr.value, env, nodes, f)
+            return base
+        if isinstance(expr, ast.Subscript):
+            t = self._eval(expr.value, env, nodes, f)
+            if isinstance(expr.slice, ast.Constant) \
+                    and isinstance(expr.slice.value, str):
+                t = t | sp.key_tags(t, expr.slice.value)
+            else:
+                self._eval(expr.slice, env, nodes, f)
+            return t
+        if isinstance(expr, ast.Call):
+            return self._eval_call(expr, env, nodes, f)
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            out = frozenset()
+            for el in expr.elts:
+                out |= self._eval(el, env, nodes, f)
+            return out
+        if isinstance(expr, ast.Dict):
+            out = frozenset()
+            for k in expr.keys:
+                if k is not None:
+                    out |= self._eval(k, env, nodes, f)
+            for v in expr.values:
+                out |= self._eval(v, env, nodes, f)
+            return out
+        if isinstance(expr, (ast.ListComp, ast.SetComp,
+                             ast.GeneratorExp, ast.DictComp)):
+            sub = dict(env)
+            for gen in expr.generators:
+                t = self._eval(gen.iter, sub, nodes, f)
+                self._assign(gen.target, t, sub, nodes, f)
+                for cond in gen.ifs:
+                    self._eval(cond, sub, nodes, f)
+            if isinstance(expr, ast.DictComp):
+                return self._eval(expr.key, sub, nodes, f) \
+                    | self._eval(expr.value, sub, nodes, f)
+            return self._eval(expr.elt, sub, nodes, f)
+        if isinstance(expr, ast.IfExp):
+            self._eval(expr.test, env, nodes, f)
+            return self._eval(expr.body, env, nodes, f) \
+                | self._eval(expr.orelse, env, nodes, f)
+        if isinstance(expr, (ast.JoinedStr,)):
+            out = frozenset()
+            for v in expr.values:
+                out |= self._eval(v, env, nodes, f)
+            return out
+        if isinstance(expr, ast.FormattedValue):
+            return self._eval(expr.value, env, nodes, f)
+        if isinstance(expr, (ast.BinOp,)):
+            return self._eval(expr.left, env, nodes, f) \
+                | self._eval(expr.right, env, nodes, f)
+        if isinstance(expr, ast.BoolOp):
+            out = frozenset()
+            for v in expr.values:
+                out |= self._eval(v, env, nodes, f)
+            return out
+        if isinstance(expr, ast.UnaryOp):
+            return self._eval(expr.operand, env, nodes, f)
+        if isinstance(expr, ast.Compare):
+            self._eval(expr.left, env, nodes, f)
+            for c in expr.comparators:
+                self._eval(c, env, nodes, f)
+            return frozenset()
+        if isinstance(expr, ast.Starred):
+            return self._eval(expr.value, env, nodes, f)
+        if isinstance(expr, ast.Lambda):
+            return frozenset()
+        if isinstance(expr, ast.NamedExpr):
+            t = self._eval(expr.value, env, nodes, f)
+            self._assign(expr.target, t, env, nodes, f)
+            return t
+        # Conservative default: union of child expressions.
+        out = frozenset()
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, ast.expr):
+                out |= self._eval(child, env, nodes, f)
+        return out
+
+    def _arg_taint(self, arg, env, nodes, f) -> frozenset:
+        """An argument that is a *reference* to a program function
+        collapses to that function's return tags — the higher-order
+        `_cached_cluster_scrape(attr, build)` seam."""
+        ref = self.program.resolve_ref(f, arg) \
+            if isinstance(arg, (ast.Name, ast.Attribute)) else None
+        direct = self._eval(arg, env, nodes, f)
+        if ref is not None and not direct:
+            return frozenset(self.summary(ref).tags)
+        return direct
+
+    def _eval_call(self, call: ast.Call, env, nodes, f) -> frozenset:
+        sp = self.spec
+        site = next((s for s in f.calls if s.node is call), None)
+        callee = site.callee if site is not None else None
+        dotted = dotted_name(call.func)
+        term = terminal_name(call.func)
+
+        arg_ts = [self._arg_taint(a, env, nodes, f) for a in call.args]
+        kw_ts = {kw.arg: self._arg_taint(kw.value, env, nodes, f)
+                 for kw in call.keywords}
+        recv_t = frozenset()
+        if isinstance(call.func, ast.Attribute):
+            recv_t = self._eval(call.func.value, env, nodes, f)
+        elif isinstance(call.func, ast.Name):
+            recv_t = env.get(call.func.id, frozenset())
+
+        # Sanitizers clear regardless of what went in.
+        if (callee in sp.sanitizers or dotted in sp.sanitizers
+                or term in sp.sanitizer_names):
+            return frozenset()
+        # Declared sources introduce.
+        intro = sp.source_calls.get(callee) \
+            or sp.source_calls.get(dotted) or frozenset()
+        # `.get("endpoint")` is the subscript lookup in method form.
+        if term == "get" and call.args \
+                and isinstance(call.args[0], ast.Constant) \
+                and isinstance(call.args[0].value, str):
+            intro = intro | sp.key_tags(recv_t, call.args[0].value)
+
+        if callee is not None:
+            target = self.program.functions[callee]
+            s = self.summary(target)
+            out = frozenset(s.tags) | frozenset(intro)
+            # Map call-site args onto parameter indices; a bound
+            # method call shifts positionals by one (self).
+            shift = 0
+            if target.cls is not None and target.params[:1] == ["self"] \
+                    and not (isinstance(call.func, ast.Attribute)
+                             and isinstance(call.func.value, ast.Name)
+                             and self._is_class_ref(f, call.func.value)):
+                shift = 1
+            for pi in s.params:
+                if shift and pi == 0:
+                    out |= recv_t
+                    continue
+                ai = pi - shift
+                if 0 <= ai < len(arg_ts):
+                    out |= arg_ts[ai]
+                elif pi < len(target.params) \
+                        and target.params[pi] in kw_ts:
+                    out |= kw_ts[target.params[pi]]
+            return out
+        # Unresolved / external: propagate through.
+        out = frozenset(intro) | recv_t
+        for t in arg_ts:
+            out |= t
+        for t in kw_ts.values():
+            out |= t
+        return out
+
+    def _is_class_ref(self, f: FuncInfo, expr: ast.Name) -> bool:
+        b = self.program.by_relpath[f.relpath].ns.get(expr.id)
+        return b is not None and b[0] == "class"

@@ -148,12 +148,20 @@ class Rule(ast.NodeVisitor):
 
 
 class ProjectRule:
-    """Whole-file-set rule (cross-module invariants)."""
+    """Whole-file-set rule (cross-module invariants).
+
+    Set ``needs_program = True`` to receive the shared
+    :class:`~tools.mtpu_lint.callgraph.Program` (symbol table + call
+    graph + taint engine substrate) as a second argument — it is built
+    ONCE per run and shared by every interprocedural rule, so a new
+    rule costs its traversal, not another whole-tree parse."""
 
     id = "P0"
     title = ""
+    needs_program = False
 
-    def check_project(self, ctxs: list[ModuleCtx]) -> list[Finding]:
+    def check_project(self, ctxs: list[ModuleCtx],
+                      program=None) -> list[Finding]:
         raise NotImplementedError
 
 
@@ -220,6 +228,37 @@ class RunResult:
     errors: list[str] = field(default_factory=list)
     files: int = 0
     baselined: int = 0
+    stats: dict = field(default_factory=dict)  # stage/rule -> seconds
+
+
+# A finding of rule X is also waived by a suppression naming any rule
+# in WAIVER_ALIASES[X].  R11 (transitive async blocking) anchors its
+# findings at the blocking SITE, so a justified `disable=R8` already
+# sitting on that line — the direct-call special case — keeps waiving
+# when the interprocedural rule rediscovers the same site through a
+# call chain of length zero.
+WAIVER_ALIASES: dict[str, set[str]] = {"R11": {"R8"}}
+
+
+def changed_files(ref: str) -> set[str] | None:
+    """Absolute paths of files differing from ``ref`` (committed or
+    not) plus untracked files; None when git rejects the ref — the
+    caller must FAIL loudly, a typo'd ref linting zero files and
+    reporting ok is the same vacuous-green trap as a typo'd path."""
+    import subprocess
+    diff = subprocess.run(
+        ["git", "-C", REPO, "diff", "--name-only", "-z", ref, "--"],
+        capture_output=True, text=True)
+    if diff.returncode != 0:
+        return None
+    untracked = subprocess.run(
+        ["git", "-C", REPO, "ls-files", "--others",
+         "--exclude-standard", "-z"],
+        capture_output=True, text=True)
+    names = [n for n in diff.stdout.split("\0") if n]
+    if untracked.returncode == 0:
+        names += [n for n in untracked.stdout.split("\0") if n]
+    return {os.path.abspath(os.path.join(REPO, n)) for n in names}
 
 
 def load_baseline(path: str | None) -> set[str]:
@@ -230,15 +269,29 @@ def load_baseline(path: str | None) -> set[str]:
     return {str(k) for k in data}
 
 
+def _alias_dependents(rule_ids: set[str]) -> set[str]:
+    """Rules whose findings a waiver for ``rule_ids`` can also absorb
+    (the other direction of WAIVER_ALIASES)."""
+    return {dep for dep, srcs in WAIVER_ALIASES.items()
+            if srcs & rule_ids}
+
+
 def run(paths: list[str], rules=None,
-        baseline_path: str | None = DEFAULT_BASELINE) -> RunResult:
+        baseline_path: str | None = DEFAULT_BASELINE,
+        file_filter: set[str] | None = None) -> RunResult:
+    import time as _time
     from .rules import all_rules
+    registry = all_rules()
     if rules is None:
-        rules = all_rules()
+        rules = registry
     res = RunResult()
     ctxs: list[ModuleCtx] = []
     missing: list[str] = []
+    t0 = _time.perf_counter()
     for path in collect_files(paths, missing):
+        if file_filter is not None and os.path.abspath(path) \
+                not in file_filter:
+            continue
         try:
             with open(path, encoding="utf-8") as f:
                 ctxs.append(ModuleCtx(path, f.read()))
@@ -248,38 +301,68 @@ def run(paths: list[str], rules=None,
         res.errors.append(f"{p}: no Python files found (typoed or "
                           "renamed path?)")
     res.files = len(ctxs)
+    res.stats["(parse)"] = _time.perf_counter() - t0
+
+    # ONE symbol table + call graph shared by every interprocedural
+    # rule — the lint budget pays the build once per run, not per rule.
+    program = None
+    if any(getattr(r, "needs_program", False) for r in rules):
+        from .callgraph import Program
+        t0 = _time.perf_counter()
+        program = Program.build(ctxs)
+        res.stats["(callgraph)"] = _time.perf_counter() - t0
 
     raw: list[Finding] = []
     for rule in rules:
+        t0 = _time.perf_counter()
         if isinstance(rule, ProjectRule):
-            raw.extend(rule.check_project(ctxs))
-            continue
-        for ctx in ctxs:
-            if rule.applies(ctx):
-                raw.extend(rule.check(ctx))
+            if getattr(rule, "needs_program", False):
+                raw.extend(rule.check_project(ctxs, program))
+            else:
+                raw.extend(rule.check_project(ctxs))
+        else:
+            for ctx in ctxs:
+                if rule.applies(ctx):
+                    raw.extend(rule.check(ctx))
+        res.stats[rule.id] = res.stats.get(rule.id, 0.0) \
+            + _time.perf_counter() - t0
 
     # Suppressions: a finding at line L is waived when a matching
-    # suppression applies to L.
+    # suppression applies to L (directly or via WAIVER_ALIASES).
     by_path = {c.relpath: c for c in ctxs}
     kept: list[Finding] = []
     for f in raw:
         ctx = by_path.get(f.path)
         waived = False
         if ctx is not None:
+            accept = {f.rule} | WAIVER_ALIASES.get(f.rule, set())
             for sup in ctx.suppressions:
-                if sup.line == f.line and f.rule in sup.rules:
+                if sup.line == f.line and (accept & sup.rules):
                     sup.used = True
                     waived = True
         if not waived:
             kept.append(f)
 
-    # Suppression hygiene: every waiver needs a justification and must
-    # actually silence something. Only waivers for rules that RAN are
-    # judged — a subset run (--rules, the obs_lint shim) must not call
-    # the other rules' waivers stale.
+    # Suppression hygiene: every waiver needs a justification, must
+    # actually silence something, and may only name rule ids that
+    # EXIST. Staleness/justification are judged only for rules that
+    # RAN — a subset run (--rules, the obs_lint shim) must not call
+    # the other rules' waivers stale — but an unknown id is judged
+    # unconditionally against the full registry: before this check, a
+    # typo like `disable=R88` was silently ignored or silently stale
+    # depending on which rules ran.
     ran_ids = {r.id for r in rules}
+    known_ids = {r.id for r in registry} | {"SUP"}
     for ctx in ctxs:
         for sup in ctx.suppressions:
+            unknown = sup.rules - known_ids
+            if unknown:
+                kept.append(Finding(
+                    "SUP", ctx.relpath, sup.comment_line,
+                    "suppression names unknown rule id(s) "
+                    f"{','.join(sorted(unknown))} — no such rule is "
+                    "registered (typo? see --list-rules); the waiver "
+                    "silences nothing"))
             if not (sup.rules & ran_ids):
                 continue
             if not sup.reason:
@@ -287,10 +370,18 @@ def run(paths: list[str], rules=None,
                     "SUP", ctx.relpath, sup.comment_line,
                     "suppression missing justification (write "
                     "'# mtpu-lint: disable=<rule> -- why')"))
-            elif not sup.used and sup.rules <= ran_ids:
-                # Staleness is only judged when EVERY listed rule ran:
-                # a 'disable=R1,O2' waiver used by R1 must not be
-                # called stale by an O2-only subset run.
+            elif not sup.used and file_filter is None \
+                    and sup.rules <= ran_ids \
+                    and _alias_dependents(sup.rules) <= ran_ids:
+                # Staleness is only judged when EVERY listed rule ran
+                # over the FULL file set: a 'disable=R1,O2' waiver used
+                # by R1 must not be called stale by an O2-only subset
+                # run, a 'disable=R8' waiver consumed via the R11 alias
+                # must not be called stale by an R8-only run, and a
+                # --changed run must not call ANY waiver stale — the
+                # partial program it builds cannot resolve taint
+                # sources / call edges living outside the changed set,
+                # so project-rule findings legitimately vanish there.
                 kept.append(Finding(
                     "SUP", ctx.relpath, sup.comment_line,
                     f"unused suppression for {','.join(sorted(sup.rules))}"
@@ -323,6 +414,13 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
                     help="baseline file of tolerated finding keys")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="GIT-REF",
+                    help="lint only files differing from GIT-REF "
+                         "(default HEAD) — pre-commit speed; a bad ref "
+                         "fails loudly")
+    ap.add_argument("--stats", action="store_true",
+                    help="per-rule wall-clock timing on stderr")
     args = ap.parse_args(argv)
 
     from .rules import all_rules
@@ -343,8 +441,27 @@ def main(argv: list[str] | None = None) -> int:
             return 1
         rules = [r for r in rules if r.id in want]
 
+    file_filter = None
+    if args.changed is not None:
+        file_filter = changed_files(args.changed)
+        if file_filter is None:
+            # Same failure class as a typoed path or rule id: a typo'd
+            # ref must not lint zero files and gate green.
+            print(f"error: --changed: git does not know ref "
+                  f"'{args.changed}'")
+            return 1
+
     res = run(args.paths or ["minio_tpu", "tools"], rules=rules,
-              baseline_path=args.baseline)
+              baseline_path=args.baseline, file_filter=file_filter)
+    if args.stats:
+        import sys
+        total = sum(res.stats.values())
+        for name, secs in sorted(res.stats.items(),
+                                 key=lambda kv: -kv[1]):
+            print(f"{name:>12}  {secs * 1000:8.1f} ms",
+                  file=sys.stderr)
+        print(f"{'total':>12}  {total * 1000:8.1f} ms",
+              file=sys.stderr)
     if args.json:
         print(json.dumps({
             "findings": [f.to_dict() for f in res.findings],

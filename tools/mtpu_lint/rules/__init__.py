@@ -1,14 +1,22 @@
-"""Rule registry: one instance of every plugin, in report order."""
+"""Rule registry: one instance of every plugin, in report order.
+
+The registry IS the contract the docs catalog and the drift gate in
+tests/test_lint_graph.py check against — a rule imported here but not
+listed in all_rules() silently never runs (exactly how O8 went missing
+for two PRs until the gate existed).
+"""
 
 from __future__ import annotations
 
 from .asyncblocking import AsyncBlockingRule
+from .asynclock import LockAcrossAwaitRule
 from .commits import CommitReplaceRule
 from .concurrency import ThreadCtxRule
 from .dispatch import DispatchPolicyRule
 from .errormap import ErrorMapRule
 from .kernels import KernelPurityRule
 from .locks import BlockingUnderLockRule
+from .lostcoro import LostCoroutineRule
 from .obs import (AutotuneMetricCallRule, DrivemonSlowlogMetricCallRule,
                   KernprofTimelineMetricCallRule,
                   LoopmonProfilerMetricCallRule, MetricNameRule,
@@ -16,9 +24,11 @@ from .obs import (AutotuneMetricCallRule, DrivemonSlowlogMetricCallRule,
                   QosMetricCallRule, SelectMetricCallRule,
                   UsageMetricCallRule,
                   WatchdogIncidentMetricCallRule)
+from .redaction import RedactionTaintRule
 from .resources import ResourceLeakRule
 from .retries import BoundedRetryRule
 from .selectscan import SelectScanRowEvalRule
+from .transblocking import TransitiveBlockingRule
 
 
 def all_rules():
@@ -33,6 +43,10 @@ def all_rules():
         AsyncBlockingRule(),
         DispatchPolicyRule(),
         SelectScanRowEvalRule(),
+        TransitiveBlockingRule(),
+        LostCoroutineRule(),
+        RedactionTaintRule(),
+        LockAcrossAwaitRule(),
         NativeAssertRule(),
         MetricNameRule(),
         QosMetricCallRule(),
@@ -40,6 +54,7 @@ def all_rules():
         DrivemonSlowlogMetricCallRule(),
         KernprofTimelineMetricCallRule(),
         WatchdogIncidentMetricCallRule(),
+        AutotuneMetricCallRule(),
         SelectMetricCallRule(),
         UsageMetricCallRule(),
         LoopmonProfilerMetricCallRule(),

@@ -26,6 +26,20 @@ A DIRECTLY AWAITED call is exempt: ``await asyncio.wait_for(...)`` and
 friends are coroutines, not blockers — the await is the proof.  Sites
 with a genuine reason (none are expected) carry the usual justified
 ``# mtpu-lint: disable=R8 -- why`` waiver.
+
+Blocking callables passed BY REFERENCE to the loop scheduling APIs are
+the same bug wearing a different syntax — ``loop.call_soon(time.sleep,
+0.2)`` and ``loop.call_later(1, functools.partial(sock.recv, 4096))``
+run the blocking call ON the loop thread without a call expression
+ever appearing inside an ``async def`` — so those are flagged too, in
+sync and async code alike (``call_soon`` is routinely invoked from
+sync helpers).  ``run_in_executor`` is the blessed escape hatch and is
+not a scheduling API for this purpose.
+
+R11 (transitive async blocking) is this rule's interprocedural
+closure: R8 is the direct-call special case, and a justified
+``disable=R8`` waiver keeps working when R11 rediscovers the same
+site through a call chain (WAIVER_ALIASES in core).
 """
 
 from __future__ import annotations
@@ -70,11 +84,49 @@ class AsyncBlockingRule(Rule):
         return ctx.relpath.startswith(("minio_tpu/s3/",
                                        "minio_tpu/rpc/"))
 
+    # Loop scheduling APIs: (terminal name -> callback arg index).
+    _SCHED = {"call_soon": 0, "call_soon_threadsafe": 0,
+              "call_later": 1, "call_at": 1}
+
     def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
         self._walk_async_body(node)
         # Keep descending: nested async defs get their own walk, and
         # nested SYNC defs may contain further async defs.
         self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # By-reference blocking callables handed to loop scheduling
+        # APIs — checked everywhere in scope, not just async bodies:
+        # the callback runs on the loop no matter which thread
+        # scheduled it.
+        idx = self._SCHED.get(terminal_name(node.func))
+        if idx is not None and isinstance(node.func, ast.Attribute) \
+                and idx < len(node.args):
+            why = self._blocking_ref_reason(node.args[idx])
+            if why is not None:
+                self.flag(node, (
+                    f"{why} passed by reference to "
+                    f"`{terminal_name(node.func)}` runs ON the event "
+                    "loop thread and stalls every connection on it — "
+                    "schedule a non-blocking callback or use "
+                    "run_in_executor"))
+        self.generic_visit(node)
+
+    @classmethod
+    def _blocking_ref_reason(cls, cb: ast.AST) -> str | None:
+        # functools.partial(fn, ...) freezes args but keeps fn's
+        # blocking nature — unwrap it (nested partials too).
+        while isinstance(cb, ast.Call) \
+                and terminal_name(cb.func) == "partial" and cb.args:
+            cb = cb.args[0]
+        if not isinstance(cb, (ast.Name, ast.Attribute)):
+            return None
+        dotted = dotted_name(cb)
+        if dotted in _BLOCKING_DOTTED:
+            return _BLOCKING_DOTTED[dotted]
+        if isinstance(cb, ast.Attribute):
+            return _BLOCKING_ATTRS.get(cb.attr)
+        return None
 
     def _walk_async_body(self, func: ast.AsyncFunctionDef) -> None:
         stack: list[ast.AST] = list(ast.iter_child_nodes(func))

@@ -1,0 +1,65 @@
+"""R14 — no ``await`` while holding a threading mutex (the async
+analog of R3).
+
+``with self._mu:`` around an ``await`` parks the WHOLE event loop
+behind a thread mutex: the coroutine suspends with the lock held, the
+loop runs other coroutines, and the moment any of them — or any worker
+thread — touches the same lock, everything behind that loop stalls
+until the original coroutine is resumed and releases.  Unlike R3 this
+is not a latency amplifier but a deadlock shape: the resuming callback
+may itself be queued behind a coroutine that wants the lock.
+
+Only synchronous ``with`` on lock-ish names (same ``_mu``/``_lock``/
+``_cv``/``mutex`` convention R3 keys on) is flagged; ``async with``
+on an ``asyncio.Lock`` is the correct tool and is untouched.  The
+established idiom stays legal: take the mutex for a micro critical
+section, RELEASE it, then await.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, dotted_name
+from .locks import _is_lockish
+
+
+class LockAcrossAwaitRule(Rule):
+    id = "R14"
+    title = ("no await inside a `with threading.Lock/RLock` region in "
+             "async code — suspending with a thread mutex held parks "
+             "the whole event loop behind it")
+
+    def applies(self, ctx) -> bool:
+        return ctx.relpath.startswith("minio_tpu/")
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._scan(node.body, [])
+        # Nested (async) defs get their own pass via generic dispatch.
+        self.generic_visit(node)
+
+    def _scan(self, body, held: list[str]) -> None:
+        for node in body:
+            self._scan_node(node, held)
+
+    def _scan_node(self, node, held: list[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # does not execute under this lexical lock
+        if isinstance(node, ast.With):
+            locks = [dotted_name(item.context_expr)
+                     for item in node.items
+                     if _is_lockish(item.context_expr)]
+            for item in node.items:
+                self._scan_node(item.context_expr, held)
+            self._scan(node.body, held + locks)
+            return
+        if isinstance(node, ast.Await) and held:
+            self.flag(node, (
+                f"await while holding threading mutex '{held[-1]}' — "
+                "the coroutine suspends with the lock held and every "
+                "thread or coroutine touching it stalls behind this "
+                "loop; release the mutex before awaiting or use an "
+                "asyncio.Lock with `async with`"))
+        for child in ast.iter_child_nodes(node):
+            self._scan_node(child, held)

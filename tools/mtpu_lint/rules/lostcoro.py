@@ -1,0 +1,78 @@
+"""R12 — no lost coroutines or dropped tasks.
+
+Two shapes, both of which swallow exceptions silently on the async
+fabric:
+
+- a call to a *coroutine function* as a bare expression statement: the
+  coroutine object is created and discarded — the body NEVER runs
+  (CPython warns `coroutine ... was never awaited` at GC time, i.e.
+  in production, not in review);
+- a `create_task` / `ensure_future` / `run_coroutine_threadsafe`
+  result discarded as a bare expression: the task runs, but nothing
+  holds a strong reference (the loop keeps only a weak set — the task
+  can be garbage-collected mid-flight) and nothing ever observes its
+  exception, so a crashed accept-loop or heartbeat dies without a log
+  line.  Store the handle (`self.track_task(...)`) or attach a
+  done-callback.
+
+Whether a bare `name(...)` is a coroutine call is answered by the
+whole-program call graph — the coroutine function is usually defined
+in another class or module.  Unresolved calls are never flagged
+(permissive closure: only a proven lost coroutine is a finding).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, ProjectRule
+from ..callgraph import Program
+
+_TASK_MAKERS = {"create_task", "ensure_future",
+                "run_coroutine_threadsafe"}
+
+
+class LostCoroutineRule(ProjectRule):
+    id = "R12"
+    title = ("no coroutine called without await and no create_task/"
+             "ensure_future/run_coroutine_threadsafe result dropped "
+             "without a stored handle or done-callback")
+    needs_program = True
+
+    def check_project(self, ctxs, program: Program = None):
+        out: list[Finding] = []
+        for f in program.functions.values():
+            if not f.relpath.startswith("minio_tpu/"):
+                continue
+            for site in f.calls:
+                if not self._is_bare_expr(f, site.node):
+                    continue
+                fn = site.node.func
+                term = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else "")
+                if term in _TASK_MAKERS:
+                    what = "task" if term != "run_coroutine_threadsafe" \
+                        else "concurrent.futures future"
+                    out.append(Finding(
+                        self.id, f.relpath, site.node.lineno,
+                        f"`{term}(...)` result dropped — the {what} "
+                        "can be garbage-collected mid-flight and its "
+                        "exception is never observed; store the handle "
+                        "(e.g. track_task) or add_done_callback"))
+                    continue
+                if site.callee is None or site.awaited:
+                    continue
+                callee = program.functions[site.callee]
+                if callee.is_async:
+                    out.append(Finding(
+                        self.id, f.relpath, site.node.lineno,
+                        f"coroutine `{callee.short()}` called without "
+                        "await — the coroutine object is discarded and "
+                        "the body never runs; await it or schedule it "
+                        "with create_task"))
+        return out
+
+    @staticmethod
+    def _is_bare_expr(f, call: ast.Call) -> bool:
+        parent = f.ctx.parents.get(call)
+        return isinstance(parent, ast.Expr)

@@ -1,0 +1,203 @@
+"""R13 — redaction taint: identity-bearing values must not reach
+unauthenticated ``/minio-tpu/v2/*`` payloads unredacted.
+
+The ``/v2`` observability surfaces (metrics, drive health, timeline,
+alerts, usage) are unauthenticated BY DESIGN — same posture as the
+Prometheus pages — which makes "what may appear there" a security
+invariant, not a style rule.  It has been broken twice before this
+rule existed (raw drive endpoints in PR 4, exception reprs in PR 9),
+both caught by hand in post-review.  This rule machine-checks it with
+the taint engine:
+
+**Sources** (declared; ``callgraph.TaintSpec``):
+
+- ``DriveMonitor.snapshot()`` → carrier ``DRIVES_DOC``; its
+  ``["endpoint"]`` field lookups derive the violation tag
+  ``ENDPOINT``; ``DriveMonitor.endpoints()`` is ``ENDPOINT`` outright;
+- ``UsageAccountant.snapshot()`` / ``class_shares()`` → carrier
+  ``USAGE_DOC``; ``["name"]`` lookups derive ``NAME`` (tenant/bucket
+  identity);
+- ``KernelProfiler.snapshot()`` → carrier ``KERN_DOC``;
+  ``["lastError"]`` lookups derive ``EXC`` (reprs carry filesystem
+  paths and compiler output);
+- names bound by ``except ... as e`` carry ``EXC`` (so ``repr(e)`` /
+  ``str(e)`` / f-strings propagate it);
+- literal credential-key lookups (``cfg["secret_key"]``,
+  ``.get("access_key")``) carry ``CRED`` unconditionally.
+
+**Sanitizers** (taint-clearing): ``redact_drives``, ``redact_usage``,
+``redacted_endpoint``, ``_redact_name``.
+
+**Sinks**:
+
+- every ``return`` inside a route branch testing a string constant
+  starting with ``/minio-tpu/v2/`` in ``minio_tpu/s3/`` (auto-
+  discovered; branches mentioning ``/admin`` are exempt — admin is
+  authenticated and serves identities verbatim on purpose).  Here the
+  CARRIER tags are violations too: returning a whole unredacted doc
+  is the worst version of the leak;
+- **relay sinks**: the ``cause`` element (index 1) of tuples returned
+  by ``evaluate`` methods in ``obs/watchdog.py``.  Alert causes reach
+  the unauthenticated ``/v2/alerts`` payload through time-delayed
+  watchdog state the forward dataflow cannot cross, so the clean-
+  cause invariant is enforced where the cause is BUILT.  Carrier tags
+  are fine here (a share ratio pulled from a usage doc is not an
+  identity) — only the derived violation tags flag.
+
+Unresolved calls propagate their arguments' taint through but never
+introduce any (see TaintEngine) — an unknown callee can neither
+manufacture a finding nor launder a real one.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, ProjectRule
+from ..callgraph import Program, TaintEngine, TaintSpec
+
+V2_PREFIX = "/minio-tpu/v2/"
+
+_CRED_KEYS = frozenset({
+    "secret_key", "access_key", "secretKey", "accessKey",
+    "password", "token", "credential", "credentials", "sessionToken"})
+
+# Violation tags, with the message fragment each one earns.
+_VIOLATIONS = {
+    "ENDPOINT": "raw drive endpoint path",
+    "NAME": "raw tenant/bucket identity",
+    "EXC": "exception text (reprs carry paths and internals)",
+    "CRED": "config credential",
+}
+# Carrier tags: whole unredacted documents — violations only when the
+# entire value reaches an unauthenticated payload.
+_CARRIERS = {
+    "DRIVES_DOC": "unredacted drivemon document (use redact_drives)",
+    "USAGE_DOC": "unredacted usage document (use redact_usage)",
+    "KERN_DOC": "unredacted kernel-profiler document",
+}
+
+
+class _Spec(TaintSpec):
+    source_calls = {
+        "minio_tpu/obs/drivemon.py::DriveMonitor.snapshot":
+            frozenset({"DRIVES_DOC"}),
+        "minio_tpu/obs/drivemon.py::DriveMonitor.endpoints":
+            frozenset({"ENDPOINT"}),
+        "minio_tpu/obs/usage.py::UsageAccountant.snapshot":
+            frozenset({"USAGE_DOC"}),
+        "minio_tpu/obs/usage.py::UsageAccountant.class_shares":
+            frozenset({"USAGE_DOC"}),
+        "minio_tpu/obs/kernprof.py::KernelProfiler.snapshot":
+            frozenset({"KERN_DOC"}),
+    }
+    sanitizer_names = frozenset({
+        "redact_drives", "redact_usage", "redacted_endpoint",
+        "_redact_name"})
+    exception_tags = frozenset({"EXC"})
+
+    def key_tags(self, base_tags, key):
+        out = set()
+        if key in _CRED_KEYS:
+            out.add("CRED")
+        if key in ("endpoint", "endpoints") and "DRIVES_DOC" in base_tags:
+            out.add("ENDPOINT")
+        if key == "name" and "USAGE_DOC" in base_tags:
+            out.add("NAME")
+        if key == "lastError" and "KERN_DOC" in base_tags:
+            out.add("EXC")
+        return frozenset(out)
+
+
+class RedactionTaintRule(ProjectRule):
+    id = "R13"
+    title = ("no drive endpoint / tenant identity / exception text / "
+             "credential taint in unauthenticated /minio-tpu/v2/* "
+             "payloads or watchdog alert causes (admin surfaces "
+             "exempt; redact_* helpers clear taint)")
+    needs_program = True
+
+    def check_project(self, ctxs, program: Program = None):
+        engine = TaintEngine(program, _Spec())
+        out: list[Finding] = []
+        for f in program.functions.values():
+            if f.relpath.startswith("minio_tpu/s3/"):
+                for ret in self._v2_returns(f.node):
+                    tags = engine.taint_of(f, ret.value)
+                    bad = {t: _VIOLATIONS.get(t) or _CARRIERS.get(t)
+                           for t in tags
+                           if t in _VIOLATIONS or t in _CARRIERS}
+                    if bad:
+                        out.append(self._finding(
+                            f, ret, bad,
+                            "unauthenticated /v2 payload"))
+        for ci in program.classes.values():
+            if ci.ctx.relpath != "minio_tpu/obs/watchdog.py":
+                continue
+            ev = ci.methods.get("evaluate")
+            if ev is None:
+                continue
+            for ret in self._returns(ev.node):
+                if not (isinstance(ret.value, ast.Tuple)
+                        and len(ret.value.elts) >= 2):
+                    continue
+                cause = ret.value.elts[1]
+                tags = engine.taint_of(ev, cause)
+                bad = {t: _VIOLATIONS[t] for t in tags
+                       if t in _VIOLATIONS}
+                if bad:
+                    out.append(self._finding(
+                        ev, ret, bad,
+                        "alert cause (served on unauthenticated "
+                        "/v2/alerts)"))
+        return out
+
+    def _finding(self, f, ret, bad: dict, where: str) -> Finding:
+        what = "; ".join(bad[t] for t in sorted(bad))
+        return Finding(
+            self.id, f.relpath, ret.lineno,
+            f"{what} flows into {where} in `{f.short()}` — redact it "
+            "(redacted_endpoint/_redact_name/redact_*) or move it to "
+            "an admin surface")
+
+    # -- sink discovery ------------------------------------------------
+
+    @classmethod
+    def _v2_returns(cls, func) -> list[ast.Return]:
+        """Returns inside `if <test mentioning '/minio-tpu/v2/...'>`
+        branches; branches whose test mentions an /admin path are the
+        authenticated surface and exempt."""
+        out: list[ast.Return] = []
+        for node in cls._walk_own(func):
+            if not isinstance(node, ast.If):
+                continue
+            consts = [c.value for c in ast.walk(node.test)
+                      if isinstance(c, ast.Constant)
+                      and isinstance(c.value, str)]
+            if not any(c.startswith(V2_PREFIX) for c in consts):
+                continue
+            if any("/admin" in c for c in consts):
+                continue
+            for sub in node.body:
+                for n in ast.walk(sub):
+                    if isinstance(n, ast.Return) and n.value is not None:
+                        out.append(n)
+        return out
+
+    @classmethod
+    def _returns(cls, func) -> list[ast.Return]:
+        return [n for n in cls._walk_own(func)
+                if isinstance(n, ast.Return) and n.value is not None]
+
+    @staticmethod
+    def _walk_own(func):
+        """Walk a function body without descending into nested defs
+        (they have their own FuncInfo and their own sinks)."""
+        stack = list(func.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))

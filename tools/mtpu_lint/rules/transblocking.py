@@ -1,0 +1,198 @@
+"""R11 — no path from event-loop code to a blocking primitive,
+across function boundaries.
+
+R8 sees `time.sleep` written directly inside an `async def`; it is
+blind to the same sleep two frames down a sync helper chain — which is
+exactly what PR 19's loopmon flight recorder keeps catching at
+runtime.  R11 closes that gap with the whole-program call graph:
+
+- **roots**: every `async def` under `minio_tpu/s3/` + `minio_tpu/rpc/`
+  (the two packages whose loops carry the fabric), plus every function
+  scheduled ONTO a loop anywhere in `minio_tpu/` — coroutines handed to
+  `create_task` / `ensure_future` / `run_coroutine_threadsafe`, and
+  sync callbacks handed to `call_soon` / `call_soon_threadsafe` /
+  `call_later` / `call_at` (those run on the loop thread too);
+- **traversal**: direct calls into resolved program functions; awaited
+  calls into async callees (their bodies run on the same loop); a
+  NON-awaited call to an async function is not traversed (nothing
+  runs — that shape is R12's lost coroutine);
+- **blocking primitives**: R8's set (`time.sleep`, sync socket ops,
+  `open`/blocking `os.*`), plus `subprocess.*`, `Future.result`,
+  bare `Lock.acquire()` *without* a timeout, and the declared
+  thread-blocking fabric entry points `RPCClient.call` (parks the
+  calling thread on a reply event) and `_LoopThread.run` (blocks on a
+  cross-thread future).
+
+Unresolved call edges are NOT traversed (permissive closure): only a
+proven chain is a finding — an unknown callee must not manufacture
+one.  Findings anchor at the blocking SITE with the chain in the
+message, so a justified `disable=R8` already on that line keeps
+working for the chain-length-zero case (see WAIVER_ALIASES in core).
+Direct blocking calls inside async defs that R8 already covers are
+left to R8.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import deque
+
+from ..core import Finding, ProjectRule, dotted_name
+from ..callgraph import FuncInfo, Program
+
+_BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep (use asyncio.sleep)",
+    "os.read": "blocking file I/O", "os.write": "blocking file I/O",
+    "os.fsync": "blocking file I/O", "os.replace": "blocking file I/O",
+    "os.rename": "blocking file I/O", "os.remove": "blocking file I/O",
+    "os.stat": "blocking file I/O", "os.listdir": "blocking file I/O",
+    "os.makedirs": "blocking file I/O",
+    "subprocess.run": "blocking subprocess",
+    "subprocess.call": "blocking subprocess",
+    "subprocess.check_call": "blocking subprocess",
+    "subprocess.check_output": "blocking subprocess",
+    "subprocess.Popen": "blocking subprocess spawn",
+}
+
+_BLOCKING_ATTRS = {
+    "wait": "blocking wait",
+    "recv": "blocking socket recv", "recv_into": "blocking socket recv",
+    "send": "blocking socket send", "sendall": "blocking socket send",
+    "sendfile": "blocking socket send",
+    "accept": "blocking socket accept",
+    "connect": "blocking socket connect",
+    "result": "blocking Future.result",
+}
+
+# Program functions that BLOCK THE CALLING THREAD by contract; calling
+# them from loop-scheduled code deadlocks or stalls the loop.
+DECLARED_BLOCKING = {
+    "minio_tpu/rpc/transport.py::RPCClient.call":
+        "thread-blocking RPCClient.call (use rpc.aio.call_async)",
+    "minio_tpu/rpc/aio.py::_LoopThread.run":
+        "thread-blocking _LoopThread.run (await the coroutine instead)",
+}
+
+_SCHED_CORO = {"create_task", "ensure_future", "run_coroutine_threadsafe"}
+_SCHED_CB = {"call_soon": 0, "call_soon_threadsafe": 0,
+             "call_later": 1, "call_at": 1}
+
+_ASYNC_SCOPES = ("minio_tpu/s3/", "minio_tpu/rpc/")
+
+
+class TransitiveBlockingRule(ProjectRule):
+    id = "R11"
+    title = ("no call chain from event-loop code (async defs in s3/ "
+             "and rpc/, or anything scheduled onto a loop) to a "
+             "blocking primitive — interprocedural closure of R8")
+    needs_program = True
+
+    def check_project(self, ctxs, program: Program = None):
+        self.findings: dict[tuple, tuple[int, Finding]] = {}
+        for root in self._roots(program):
+            self._walk(program, root)
+        return [f for _depth, f in self.findings.values()]
+
+    # -- roots ---------------------------------------------------------
+
+    def _roots(self, program: Program) -> list[FuncInfo]:
+        roots: dict[str, FuncInfo] = {}
+        for f in program.functions.values():
+            if f.is_async and f.relpath.startswith(_ASYNC_SCOPES):
+                roots[f.qname] = f
+            if not f.relpath.startswith("minio_tpu/"):
+                continue
+            for site in f.calls:
+                fn = site.node.func
+                term = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else "")
+                if term in _SCHED_CORO and site.node.args:
+                    arg = site.node.args[0]
+                    if isinstance(arg, ast.Call):
+                        tgt = program.resolve_ref(f, arg.func)
+                        if tgt is not None and tgt.is_async:
+                            roots[tgt.qname] = tgt
+                elif term in _SCHED_CB:
+                    idx = _SCHED_CB[term]
+                    if idx < len(site.node.args):
+                        tgt = program.resolve_ref(f, site.node.args[idx])
+                        if tgt is not None:
+                            roots[tgt.qname] = tgt
+        return list(roots.values())
+
+    # -- traversal -----------------------------------------------------
+
+    def _walk(self, program: Program, root: FuncInfo) -> None:
+        seen = {root.qname}
+        queue: deque[tuple[FuncInfo, tuple[str, ...]]] = deque(
+            [(root, (root.short(),))])
+        while queue:
+            func, chain = queue.popleft()
+            for site in func.calls:
+                why = self._blocking_reason(site)
+                if why is not None:
+                    # A blocking call written directly inside an async
+                    # def under s3//rpc/ IS R8 (the direct-call special
+                    # case) — one rule, one finding per site.
+                    direct_r8 = (func.is_async
+                                 and func.relpath.startswith(
+                                     _ASYNC_SCOPES))
+                    if not direct_r8:
+                        self._flag(func, site, chain, why, root)
+                if site.callee is None:
+                    continue  # permissive: unproven edges never flag
+                callee = program.functions[site.callee]
+                if callee.is_async and not site.awaited:
+                    continue  # never runs here — R12's territory
+                if callee.qname in DECLARED_BLOCKING \
+                        or callee.qname in seen:
+                    continue
+                seen.add(callee.qname)
+                queue.append((callee, chain + (callee.short(),)))
+
+    def _flag(self, func: FuncInfo, site, chain: tuple[str, ...],
+              why: str, root: FuncInfo) -> None:
+        key = (func.relpath, site.node.lineno)
+        depth = len(chain)
+        old = self.findings.get(key)
+        if old is not None and old[0] <= depth:
+            return  # keep the shortest proving chain per site
+        kind = "async" if root.is_async else "loop-scheduled"
+        via = " → ".join(chain)
+        self.findings[key] = (depth, Finding(
+            self.id, func.relpath, site.node.lineno,
+            f"{why} reachable from {kind} `{root.short()}` via {via} — "
+            "this stalls every coroutine on that event loop; move the "
+            "blocking work behind run_in_executor or use the async "
+            "equivalent"))
+
+    # -- blocking primitives -------------------------------------------
+
+    @staticmethod
+    def _blocking_reason(site) -> str | None:
+        if site.awaited:
+            return None  # an awaited call is a coroutine — the proof
+        call = site.node
+        if site.callee is not None:
+            return DECLARED_BLOCKING.get(site.callee)
+        fn = call.func
+        if isinstance(fn, ast.Name) and fn.id == "open":
+            return "blocking file open"
+        unresolved = site.unresolved or ""
+        ext = unresolved.split(":", 1)[1] \
+            if unresolved.startswith("external:") else ""
+        dotted = dotted_name(fn)
+        for name in (ext, dotted):
+            if name in _BLOCKING_DOTTED:
+                return _BLOCKING_DOTTED[name]
+            if name.startswith("subprocess."):
+                return "blocking subprocess"
+        if isinstance(fn, ast.Attribute):
+            if fn.attr == "acquire":
+                bounded = call.args or any(
+                    kw.arg in ("timeout", "blocking")
+                    for kw in call.keywords)
+                return None if bounded \
+                    else "blocking lock acquire (no timeout)"
+            return _BLOCKING_ATTRS.get(fn.attr)
+        return None
